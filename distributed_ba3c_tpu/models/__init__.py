@@ -1,0 +1,10 @@
+"""Model library: the BA3C policy/value convnet and reusable layers.
+
+Reference equivalent: ``tensorpack/models/*.py`` layer registry + the concrete
+``Model(ModelDesc)`` in ``src/train.py`` (SURVEY.md §2.1 #2, §2.6 #17).
+"""
+
+from distributed_ba3c_tpu.models.a3c import BA3CNet, PolicyValue
+from distributed_ba3c_tpu.models.layers import PReLU
+
+__all__ = ["BA3CNet", "PolicyValue", "PReLU"]

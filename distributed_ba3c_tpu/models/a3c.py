@@ -1,0 +1,82 @@
+"""The BA3C policy/value convnet.
+
+Reference equivalent: ``Model._build_graph`` in ``src/train.py`` (SURVEY.md
+§2.1 #2) — the Tensorpack train-atari architecture:
+
+    input uint8 [B, 84, 84, FRAME_HISTORY] / 255
+    Conv 32@5x5 -> MaxPool 2 -> Conv 32@5x5 -> MaxPool 2
+    Conv 64@4x4 -> MaxPool 2 -> Conv 64@3x3
+    FC 512 + PReLU
+    -> policy logits [B, A]    (FC A)
+    -> value [B]               (FC 1)
+
+TPU-native design decisions:
+- NHWC layout, bfloat16 compute / float32 params (MXU-friendly; convs at these
+  sizes map onto the MXU as implicit GEMMs).
+- uint8 states cross the host->device boundary; the /255 cast happens on
+  device, so PCIe/ICI traffic is 1 byte per pixel (the reference ships uint8
+  over ZMQ for the same reason).
+- One module serves both the learner (value+logits) and the actor serving path
+  (vmapped under jit in predict/server.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_ba3c_tpu.models.layers import PReLU
+
+
+class PolicyValue(NamedTuple):
+    logits: jax.Array  # [B, A] float32
+    value: jax.Array   # [B] float32
+
+
+class BA3CNet(nn.Module):
+    """Policy/value network with the reference's conv stack."""
+
+    num_actions: int
+    fc_units: int = 512
+    conv_features: Sequence[int] = (32, 32, 64, 64)
+    conv_kernels: Sequence[int] = (5, 5, 4, 3)
+    # maxpool after first 3 conv layers, as in the reference stack
+    pooled_layers: Tuple[bool, ...] = (True, True, True, False)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, state: jax.Array) -> PolicyValue:
+        """state: [B, H, W, C] uint8 (or float already scaled)."""
+        if state.dtype == jnp.uint8:
+            x = state.astype(self.compute_dtype) / 255.0
+        else:
+            x = state.astype(self.compute_dtype)
+
+        for feats, k, pooled in zip(
+            self.conv_features, self.conv_kernels, self.pooled_layers, strict=True
+        ):
+            x = nn.Conv(
+                features=feats,
+                kernel_size=(k, k),
+                padding="SAME",
+                dtype=self.compute_dtype,
+                param_dtype=jnp.float32,
+            )(x)
+            x = nn.relu(x)
+            if pooled:
+                x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.fc_units, dtype=self.compute_dtype, param_dtype=jnp.float32)(x)
+        x = PReLU()(x)
+
+        logits = nn.Dense(
+            self.num_actions, dtype=jnp.float32, param_dtype=jnp.float32
+        )(x.astype(jnp.float32))
+        value = nn.Dense(1, dtype=jnp.float32, param_dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )[:, 0]
+        return PolicyValue(logits=logits, value=value)

@@ -1,0 +1,30 @@
+"""Reusable layers mirroring the reference's model zoo where flax lacks them.
+
+Reference equivalent: ``tensorpack/models/nonlin.py`` (PReLU) and friends
+(SURVEY.md §2.6 #17). Conv/Dense/Pooling come from flax.linen directly — we do
+not re-wrap what the library already expresses idiomatically.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class PReLU(nn.Module):
+    """Parametric ReLU with a single learnable slope (tensorpack default).
+
+    tensorpack's ``PReLU`` initialises alpha to 0.001 and shares it across the
+    whole activation map; we keep that so the flagship model matches the
+    reference architecture knob-for-knob.
+    """
+
+    init_alpha: float = 0.001
+
+    @nn.compact
+    def __call__(self, x):
+        alpha = self.param(
+            "alpha", lambda _key, shape: jnp.full(shape, self.init_alpha, jnp.float32), ()
+        )
+        alpha = alpha.astype(x.dtype)
+        return jnp.where(x >= 0, x, alpha * x)

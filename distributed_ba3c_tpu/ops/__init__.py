@@ -1,0 +1,25 @@
+"""Pure-function numeric ops: returns, losses, off-policy corrections, grad processing.
+
+Everything here is side-effect free and jit/vmap/scan friendly — the TPU-native
+replacement for the symbolic-graph snippets the reference scatters through
+``src/train.py`` (loss construction in ``Model._build_graph``) and
+``tensorpack/tfutils/{gradproc,symbolic_functions}.py`` (SURVEY.md §2.1 #2, §2.5 #16).
+"""
+
+from distributed_ba3c_tpu.ops.returns import (
+    discounted_returns,
+    discounted_returns_np,
+    n_step_returns,
+)
+from distributed_ba3c_tpu.ops.loss import a3c_loss, A3CLossOut
+from distributed_ba3c_tpu.ops.vtrace import vtrace_returns, VTraceOut
+
+__all__ = [
+    "discounted_returns",
+    "discounted_returns_np",
+    "n_step_returns",
+    "a3c_loss",
+    "A3CLossOut",
+    "vtrace_returns",
+    "VTraceOut",
+]

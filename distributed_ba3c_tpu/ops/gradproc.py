@@ -1,0 +1,65 @@
+"""Gradient processors: the optax-native equivalent of tensorpack's gradproc.
+
+Reference equivalent: ``tensorpack/tfutils/gradproc.py`` — ``GlobalNormClip``,
+``MapGradient``, ``SummaryGradient`` (SURVEY.md §2.5 #16). In the rebuild these
+are optax ``GradientTransformation``s chained into the optimizer, plus a pure
+function computing gradient statistics for the summary plane.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def global_norm_clip(max_norm: float) -> optax.GradientTransformation:
+    """tensorpack ``GlobalNormClip`` equivalent (tf.clip_by_global_norm)."""
+    return optax.clip_by_global_norm(max_norm)
+
+
+def map_gradient(fn: Callable[[jax.Array], jax.Array]) -> optax.GradientTransformation:
+    """tensorpack ``MapGradient`` equivalent: apply fn to every gradient leaf."""
+
+    def init(_params):
+        return optax.EmptyState()
+
+    def update(grads, state, params=None):
+        del params
+        return jax.tree_util.tree_map(fn, grads), state
+
+    return optax.GradientTransformation(init, update)
+
+
+def grad_summaries(grads) -> Dict[str, jax.Array]:
+    """tensorpack ``SummaryGradient`` equivalent: global/max statistics.
+
+    Returned inside the jitted step so it fuses with the backward pass instead
+    of being a separate host round-trip.
+    """
+    leaves = jax.tree_util.tree_leaves(grads)
+    gnorm = optax.global_norm(grads)
+    gmax = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in leaves]))
+    return {"grad_norm": gnorm, "grad_max_abs": gmax}
+
+
+def make_optimizer(
+    learning_rate,
+    adam_epsilon: float = 1e-3,
+    grad_clip_norm: float = 0.5,
+) -> optax.GradientTransformation:
+    """Adam + global-norm clip, LR injectable at runtime.
+
+    Reference: ``Model._get_optimizer`` (AdamOptimizer with tweaked epsilon,
+    SURVEY.md §2.9) wrapped by ``GlobalNormClip``. ``learning_rate`` may be a
+    float, an optax schedule, or supplied per-step via ``optax.inject_hyperparams``
+    by the caller (the ScheduledHyperParamSetter callback mutates it live).
+    """
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip_norm),
+        optax.inject_hyperparams(optax.adam)(
+            learning_rate=learning_rate, eps=adam_epsilon
+        ),
+    )

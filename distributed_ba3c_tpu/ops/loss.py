@@ -1,0 +1,79 @@
+"""The BA3C loss: policy gradient + value regression + entropy bonus.
+
+Reference equivalent: ``Model._build_graph`` in ``src/train.py``
+(SURVEY.md §2.1 #2):
+
+    L = -log pi(a|s) * stop_grad(R - V)  +  c * L2(V, R)  -  beta * H(pi)
+
+TPU-native design: a single pure function over batched logits/values so the
+whole loss + grad fuses into one XLA computation; all reductions are batch
+means (stable under per-device sharding: the DP train step psum-averages
+gradients, see parallel/train_step.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class A3CLossOut(NamedTuple):
+    total: jax.Array        # scalar loss to differentiate
+    policy_loss: jax.Array  # scalar, for logging
+    value_loss: jax.Array   # scalar
+    entropy: jax.Array      # scalar mean policy entropy (positive)
+    advantage: jax.Array    # scalar mean advantage
+    pred_value: jax.Array   # scalar mean predicted value
+
+
+def a3c_loss(
+    logits: jax.Array,
+    values: jax.Array,
+    actions: jax.Array,
+    returns: jax.Array,
+    entropy_beta: float | jax.Array = 0.01,
+    value_loss_coef: float | jax.Array = 0.5,
+) -> A3CLossOut:
+    """Compute the A3C objective over a flat batch.
+
+    Args:
+      logits:  [B, A] unnormalised policy logits.
+      values:  [B] state-value predictions V(s).
+      actions: [B] int32 actions taken by the behaviour policy.
+      returns: [B] n-step discounted returns R.
+      entropy_beta: entropy bonus coefficient (scheduled at runtime, so it may
+        be a traced scalar — reference schedules it via HyperParamSetter).
+      value_loss_coef: weight on the value L2 term.
+
+    All statistics are means over the batch, so the loss is invariant to how
+    the batch is sharded across devices.
+    """
+    logits = logits.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    returns = returns.astype(jnp.float32)
+
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    action_log_probs = jnp.take_along_axis(
+        log_probs, actions.astype(jnp.int32)[:, None], axis=-1
+    )[:, 0]
+
+    advantage = returns - jax.lax.stop_gradient(values)
+    policy_loss = -jnp.mean(action_log_probs * advantage)
+
+    value_loss = 0.5 * jnp.mean(jnp.square(values - returns))
+
+    entropy = -jnp.mean(jnp.sum(probs * log_probs, axis=-1))
+
+    total = policy_loss + value_loss_coef * value_loss - entropy_beta * entropy
+    return A3CLossOut(
+        total=total,
+        policy_loss=policy_loss,
+        value_loss=value_loss,
+        entropy=entropy,
+        advantage=jnp.mean(advantage),
+        pred_value=jnp.mean(values),
+    )

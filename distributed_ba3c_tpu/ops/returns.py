@@ -1,0 +1,74 @@
+"""n-step discounted return computation.
+
+Reference equivalent: ``MySimulatorMaster._parse_memory`` in ``src/train.py``
+(SURVEY.md §2.1 #3, §3.2) — a Python loop that walks a client's
+``TransitionExperience`` memory backwards accumulating
+``R = r_t + GAMMA * R`` seeded with the bootstrap value of the last state.
+
+TPU-native design: the device-side version is a reverse ``lax.scan`` so it can
+run inside a jitted/fused actor-learner loop over whole rollout batches with
+static shapes; the numpy version is for the host-side actor plane
+(SimulatorMaster), where rollouts are short (LOCAL_TIME_MAX ≈ 5) python lists.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def discounted_returns(rewards: jax.Array, bootstrap: jax.Array, discounts: jax.Array) -> jax.Array:
+    """Reverse-scan discounted returns.
+
+    R_t = r_t + discount_t * R_{t+1},  R_T = bootstrap.
+
+    Args:
+      rewards:   [T, ...] rewards (time-major).
+      bootstrap: [...] value estimate of the state after the last transition.
+      discounts: [T, ...] per-step discount (gamma * (1 - done)).
+
+    Returns:
+      [T, ...] discounted returns.
+    """
+
+    def step(carry, xs):
+        r, d = xs
+        ret = r + d * carry
+        return ret, ret
+
+    _, returns = jax.lax.scan(step, bootstrap, (rewards, discounts), reverse=True)
+    return returns
+
+
+def n_step_returns(
+    rewards: jax.Array,
+    dones: jax.Array,
+    bootstrap_value: jax.Array,
+    gamma: float,
+) -> jax.Array:
+    """n-step returns over a [T, B] rollout with episode-boundary resets.
+
+    The discount is zeroed at terminal steps so credit does not leak across
+    episode boundaries (matching the reference's per-episode memory flush in
+    ``SimulatorMaster._on_episode_over``, SURVEY.md §3.2).
+    """
+    discounts = gamma * (1.0 - dones.astype(rewards.dtype))
+    return discounted_returns(rewards, bootstrap_value, discounts)
+
+
+def discounted_returns_np(
+    rewards: np.ndarray, bootstrap: float, gamma: float
+) -> np.ndarray:
+    """Host-side scalar-loop version for short actor-side rollouts.
+
+    Mirrors the reference's ``_parse_memory`` accumulation exactly: the rollout
+    is either episode-terminated (bootstrap = 0) or truncated at LOCAL_TIME_MAX
+    (bootstrap = V(s_T) from the most recent inference).
+    """
+    returns = np.empty(len(rewards), dtype=np.float32)
+    acc = float(bootstrap)
+    for t in range(len(rewards) - 1, -1, -1):
+        acc = float(rewards[t]) + gamma * acc
+        returns[t] = acc
+    return returns

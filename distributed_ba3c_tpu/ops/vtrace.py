@@ -1,0 +1,74 @@
+"""V-trace off-policy correction (IMPALA, Espeholt et al. 2018).
+
+The reference has no off-policy correction — its async parameter-server updates
+simply tolerate staleness (SURVEY.md §2.5 #15, §3.4). The TPU rebuild's learner
+is synchronous, so actor/learner policy lag shows up explicitly; V-trace is the
+principled correction for it (BASELINE.json config #4). Implemented as a
+reverse ``lax.scan`` over time-major tensors, jit/vmap friendly.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class VTraceOut(NamedTuple):
+    vs: jax.Array                 # [T, B] V-trace value targets
+    pg_advantages: jax.Array      # [T, B] policy-gradient advantages
+    clipped_rhos: jax.Array       # [T, B] clipped importance weights
+
+
+def vtrace_returns(
+    behaviour_log_probs: jax.Array,
+    target_log_probs: jax.Array,
+    rewards: jax.Array,
+    dones: jax.Array,
+    values: jax.Array,
+    bootstrap_value: jax.Array,
+    gamma: float,
+    rho_clip: float = 1.0,
+    c_clip: float = 1.0,
+) -> VTraceOut:
+    """Compute V-trace targets and advantages.
+
+    Args:
+      behaviour_log_probs: [T, B] log mu(a_t|s_t) of the actor policy.
+      target_log_probs:    [T, B] log pi(a_t|s_t) of the learner policy.
+      rewards:             [T, B].
+      dones:               [T, B] episode-termination flags (after step t).
+      values:              [T, B] learner V(s_t).
+      bootstrap_value:     [B]    learner V(s_{T}).
+      gamma:               discount.
+      rho_clip, c_clip:    IW clip thresholds (rho_bar >= c_bar per the paper).
+
+    Returns:
+      VTraceOut with value targets vs_t and pg advantages
+      rho_t * (r_t + gamma * vs_{t+1} - V(s_t)).
+    """
+    rhos = jnp.exp(target_log_probs - behaviour_log_probs)
+    clipped_rhos = jnp.minimum(rho_clip, rhos)
+    cs = jnp.minimum(c_clip, rhos)
+    discounts = gamma * (1.0 - dones.astype(values.dtype))
+
+    values_tp1 = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (rewards + discounts * values_tp1 - values)
+
+    def step(acc, xs):
+        delta_t, discount_t, c_t = xs
+        acc = delta_t + discount_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        step,
+        jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, cs),
+        reverse=True,
+    )
+    vs = vs_minus_v + values
+
+    vs_tp1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_advantages = clipped_rhos * (rewards + discounts * vs_tp1 - values)
+    return VTraceOut(vs=vs, pg_advantages=pg_advantages, clipped_rhos=clipped_rhos)

@@ -1,0 +1,25 @@
+"""Parallel execution: device meshes, the sync data-parallel train step, multi-host.
+
+This package replaces the reference's entire distributed-execution layer
+(SURVEY.md §2.5): ``AsyncMultiGPUTrainer``'s lock-free threads and the TF
+parameter-server/gRPC gradient plane both collapse into one jitted synchronous
+update whose per-device gradients meet in a single ``lax.psum`` over the ICI
+mesh (BASELINE.json north_star). There is no parameter server: params live
+replicated in HBM.
+"""
+
+from distributed_ba3c_tpu.parallel.mesh import make_mesh, DATA_AXIS, MODEL_AXIS
+from distributed_ba3c_tpu.parallel.train_step import (
+    TrainState,
+    create_train_state,
+    make_train_step,
+)
+
+__all__ = [
+    "make_mesh",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "TrainState",
+    "create_train_state",
+    "make_train_step",
+]

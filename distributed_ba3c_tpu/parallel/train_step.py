@@ -1,0 +1,143 @@
+"""The synchronous data-parallel BA3C train step.
+
+Replaces, wholesale (SURVEY.md §3.4): the reference's
+``sess.run(train_op)`` → per-variable async gradient push to parameter servers
+over gRPC. Here: each device computes gradients on its batch shard, a single
+``lax.psum`` averages them over the ICI ``data`` axis, and every device applies
+the identical Adam update to its replicated params. One jitted computation, no
+staleness, no PS.
+
+Sharding layout:
+  params/opt_state: replicated (PartitionSpec())
+  batch:            sharded on the leading axis (PartitionSpec('data'))
+The step is expressed with ``jax.shard_map`` so the collective is explicit and
+the compiled module is identical regardless of host count (multi-host just
+widens the mesh; see parallel/distributed.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_ba3c_tpu.config import BA3CConfig
+from distributed_ba3c_tpu.models.a3c import BA3CNet
+from distributed_ba3c_tpu.ops.gradproc import grad_summaries
+from distributed_ba3c_tpu.ops.loss import a3c_loss
+from distributed_ba3c_tpu.parallel.mesh import DATA_AXIS
+
+
+class TrainState(struct.PyTreeNode):
+    """Learner state: params + optimizer state + step counter.
+
+    Reference equivalent: the TF variables living on parameter servers plus the
+    global_step (SURVEY.md §2.5). Replicated across the mesh.
+    """
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def create_train_state(
+    rng: jax.Array,
+    model: BA3CNet,
+    cfg: BA3CConfig,
+    optimizer: optax.GradientTransformation,
+) -> TrainState:
+    dummy = jnp.zeros((1, *cfg.state_shape), jnp.uint8)
+    params = model.init(rng, dummy)["params"]
+    opt_state = optimizer.init(params)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
+
+
+def _local_step(
+    model: BA3CNet,
+    optimizer: optax.GradientTransformation,
+    cfg: BA3CConfig,
+    state: TrainState,
+    batch: Dict[str, jax.Array],
+    entropy_beta: jax.Array,
+) -> Tuple[TrainState, Dict[str, jax.Array]]:
+    """Per-device shard-local step body; runs inside shard_map."""
+
+    def loss_fn(params):
+        out = model.apply({"params": params}, batch["state"])
+        loss = a3c_loss(
+            out.logits,
+            out.value,
+            batch["action"],
+            batch["return"],
+            entropy_beta=entropy_beta,
+            value_loss_coef=cfg.value_loss_coef,
+        )
+        return loss.total, loss
+
+    (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+
+    # The one collective that replaces the reference's whole PS gradient plane.
+    # Under shard_map's check_vma=True semantics the transpose auto-inserts the
+    # psum for the replicated params (grads arrive device-invariant, SUMMED over
+    # the data axis); dividing by the axis size yields the global batch mean.
+    # (An explicit lax.pmean here would double-count by the axis size.)
+    n_data = jax.lax.axis_size(DATA_AXIS)
+    grads = jax.tree_util.tree_map(lambda g: g / n_data, grads)
+
+    updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+    new_params = optax.apply_updates(state.params, updates)
+    new_state = TrainState(
+        step=state.step + 1, params=new_params, opt_state=new_opt_state
+    )
+
+    metrics = {
+        "loss": aux.total,
+        "policy_loss": aux.policy_loss,
+        "value_loss": aux.value_loss,
+        "entropy": aux.entropy,
+        "advantage": aux.advantage,
+        "pred_value": aux.pred_value,
+        **grad_summaries(grads),
+    }
+    metrics = {k: jax.lax.pmean(v, DATA_AXIS) for k, v in metrics.items()}
+    return new_state, metrics
+
+
+def make_train_step(
+    model: BA3CNet,
+    optimizer: optax.GradientTransformation,
+    cfg: BA3CConfig,
+    mesh: Mesh,
+) -> Callable[[TrainState, Dict[str, jax.Array], jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """Build the jitted, mesh-sharded train step.
+
+    Returns fn(state, batch, entropy_beta) -> (state, metrics) with donated
+    state buffers. ``batch`` leading dim must be divisible by the mesh's data
+    axis size.
+    """
+    replicated = P()
+    batch_spec = P(DATA_AXIS)
+
+    body = functools.partial(_local_step, model, optimizer, cfg)
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(replicated, batch_spec, replicated),
+        out_specs=(replicated, replicated),
+    )
+
+    jitted = jax.jit(sharded, donate_argnums=(0,))
+
+    def step(state, batch, entropy_beta):
+        return jitted(state, batch, jnp.asarray(entropy_beta, jnp.float32))
+
+    # expose shardings so callers can device_put batches asynchronously
+    step.batch_sharding = NamedSharding(mesh, batch_spec)
+    step.state_sharding = NamedSharding(mesh, replicated)
+    step.mesh = mesh
+    return step

@@ -1,0 +1,31 @@
+"""Test harness: fake 8-device CPU mesh.
+
+The reference could only validate distributed behavior on a live cluster
+(SURVEY.md §4). We do better: XLA's host-platform device-count flag gives an
+8-device CPU mesh, so every psum/sharding code path is unit-testable with zero
+TPU hardware. Must run before jax is first imported.
+"""
+
+import os
+
+# The container's axon sitecustomize force-registers the TPU backend and sets
+# JAX_PLATFORMS=axon; a plain setdefault is not enough. Assign the env var AND
+# override jax.config right after import (register() re-appends the plugin).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,56 @@
+"""Golden-value tests for the BA3C loss (SURVEY.md §7 step 1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_ba3c_tpu.ops import a3c_loss
+
+
+def test_loss_components_match_numpy():
+    rng = np.random.default_rng(0)
+    B, A = 16, 4
+    logits = rng.normal(size=(B, A)).astype(np.float32)
+    values = rng.normal(size=(B,)).astype(np.float32)
+    actions = rng.integers(0, A, size=(B,)).astype(np.int32)
+    returns = rng.normal(size=(B,)).astype(np.float32)
+    beta, vc = 0.01, 0.5
+
+    out = a3c_loss(jnp.array(logits), jnp.array(values), jnp.array(actions),
+                   jnp.array(returns), beta, vc)
+
+    # numpy reference
+    z = logits - logits.max(axis=1, keepdims=True)
+    logp = z - np.log(np.exp(z).sum(axis=1, keepdims=True))
+    p = np.exp(logp)
+    alp = logp[np.arange(B), actions]
+    adv = returns - values
+    pl = -(alp * adv).mean()
+    vl = 0.5 * ((values - returns) ** 2).mean()
+    ent = -(p * logp).sum(axis=1).mean()
+
+    np.testing.assert_allclose(out.policy_loss, pl, rtol=1e-5)
+    np.testing.assert_allclose(out.value_loss, vl, rtol=1e-5)
+    np.testing.assert_allclose(out.entropy, ent, rtol=1e-5)
+    np.testing.assert_allclose(out.total, pl + vc * vl - beta * ent, rtol=1e-5)
+
+
+def test_policy_gradient_ignores_value_through_advantage():
+    """Advantage uses stop_grad(V): d(policy_loss)/d(values) must be zero."""
+    B, A = 4, 3
+    logits = jnp.ones((B, A))
+    actions = jnp.zeros((B,), jnp.int32)
+    returns = jnp.ones((B,))
+
+    def pol_loss(values):
+        return a3c_loss(logits, values, actions, returns, 0.0, 0.0).policy_loss
+
+    g = jax.grad(pol_loss)(jnp.zeros((B,)))
+    np.testing.assert_allclose(np.asarray(g), 0.0)
+
+
+def test_entropy_of_uniform_policy():
+    B, A = 2, 4
+    out = a3c_loss(jnp.zeros((B, A)), jnp.zeros((B,)), jnp.zeros((B,), jnp.int32),
+                   jnp.zeros((B,)))
+    np.testing.assert_allclose(out.entropy, np.log(A), rtol=1e-6)

@@ -1,0 +1,39 @@
+"""Shape/dtype tests for the BA3C convnet."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_ba3c_tpu.config import BA3CConfig
+from distributed_ba3c_tpu.models import BA3CNet
+
+
+def test_forward_shapes_and_dtypes():
+    cfg = BA3CConfig(num_actions=6)
+    model = BA3CNet(num_actions=cfg.num_actions)
+    params = model.init(jax.random.key(0), jnp.zeros((1, *cfg.state_shape), jnp.uint8))
+    state = jnp.zeros((8, *cfg.state_shape), jnp.uint8)
+    out = model.apply(params, state)
+    assert out.logits.shape == (8, 6)
+    assert out.value.shape == (8,)
+    assert out.logits.dtype == jnp.float32
+    assert out.value.dtype == jnp.float32
+
+
+def test_params_are_float32():
+    model = BA3CNet(num_actions=4)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 84, 84, 4), jnp.uint8))
+    for leaf in jax.tree_util.tree_leaves(params):
+        assert leaf.dtype == jnp.float32
+
+
+def test_uint8_and_prescaled_inputs_agree():
+    model = BA3CNet(num_actions=4)
+    key = jax.random.key(1)
+    params = model.init(key, jnp.zeros((1, 84, 84, 4), jnp.uint8))
+    state_u8 = jax.random.randint(key, (2, 84, 84, 4), 0, 256, jnp.int32).astype(jnp.uint8)
+    out_u8 = model.apply(params, state_u8)
+    out_f = model.apply(params, state_u8.astype(jnp.bfloat16) / 255.0)
+    np.testing.assert_allclose(
+        np.asarray(out_u8.logits), np.asarray(out_f.logits), atol=2e-2
+    )

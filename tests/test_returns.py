@@ -1,0 +1,40 @@
+"""Golden-value tests for n-step return computation (SURVEY.md §7 step 1)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_ba3c_tpu.ops import (
+    discounted_returns_np,
+    n_step_returns,
+)
+
+
+def test_discounted_returns_np_matches_hand_computation():
+    # r = [1, 0, 2], bootstrap 10, gamma 0.5
+    # R2 = 2 + 0.5*10 = 7 ; R1 = 0 + 0.5*7 = 3.5 ; R0 = 1 + 0.5*3.5 = 2.75
+    out = discounted_returns_np(np.array([1.0, 0.0, 2.0]), bootstrap=10.0, gamma=0.5)
+    np.testing.assert_allclose(out, [2.75, 3.5, 7.0])
+
+
+def test_n_step_returns_matches_numpy_no_done():
+    rng = np.random.default_rng(1)
+    T, B = 7, 3
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    bootstrap = rng.normal(size=(B,)).astype(np.float32)
+    dones = np.zeros((T, B), np.float32)
+    gamma = 0.99
+
+    got = np.asarray(n_step_returns(jnp.array(rewards), jnp.array(dones), jnp.array(bootstrap), gamma))
+    for b in range(B):
+        want = discounted_returns_np(rewards[:, b], bootstrap[b], gamma)
+        np.testing.assert_allclose(got[:, b], want, rtol=1e-5)
+
+
+def test_n_step_returns_resets_at_episode_boundary():
+    gamma = 0.9
+    rewards = jnp.array([[1.0], [1.0], [1.0]])
+    dones = jnp.array([[0.0], [1.0], [0.0]])  # episode ends after t=1
+    bootstrap = jnp.array([5.0])
+    out = np.asarray(n_step_returns(rewards, dones, bootstrap, gamma))
+    # R2 = 1 + .9*5 = 5.5 ; R1 = 1 (done: no leak from R2) ; R0 = 1 + .9*1 = 1.9
+    np.testing.assert_allclose(out[:, 0], [1.9, 1.0, 5.5], rtol=1e-6)

@@ -1,0 +1,65 @@
+"""Tests for V-trace: on-policy reduction + golden recursion check."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_ba3c_tpu.ops import vtrace_returns
+from distributed_ba3c_tpu.ops import n_step_returns
+
+
+def _rand(rng, *shape):
+    return rng.normal(size=shape).astype(np.float32)
+
+
+def test_on_policy_vtrace_equals_n_step_returns():
+    """With pi == mu and no clipping active, vs_t equals n-step returns."""
+    rng = np.random.default_rng(2)
+    T, B = 6, 4
+    logp = np.log(np.full((T, B), 0.25, np.float32))
+    rewards = _rand(rng, T, B)
+    values = _rand(rng, T, B)
+    bootstrap = _rand(rng, B)
+    dones = np.zeros((T, B), np.float32)
+    gamma = 0.95
+
+    out = vtrace_returns(
+        jnp.array(logp), jnp.array(logp), jnp.array(rewards), jnp.array(dones),
+        jnp.array(values), jnp.array(bootstrap), gamma,
+    )
+    want = n_step_returns(jnp.array(rewards), jnp.array(dones), jnp.array(bootstrap), gamma)
+    np.testing.assert_allclose(np.asarray(out.vs), np.asarray(want), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.clipped_rhos), 1.0)
+
+
+def test_vtrace_matches_sequential_recursion():
+    rng = np.random.default_rng(3)
+    T, B = 5, 2
+    b_logp = _rand(rng, T, B)
+    t_logp = _rand(rng, T, B)
+    rewards = _rand(rng, T, B)
+    values = _rand(rng, T, B)
+    bootstrap = _rand(rng, B)
+    dones = (rng.random((T, B)) < 0.2).astype(np.float32)
+    gamma, rho_bar, c_bar = 0.9, 1.0, 1.0
+
+    out = vtrace_returns(
+        jnp.array(b_logp), jnp.array(t_logp), jnp.array(rewards), jnp.array(dones),
+        jnp.array(values), jnp.array(bootstrap), gamma, rho_bar, c_bar,
+    )
+
+    # sequential reference implementation straight from the paper
+    rhos = np.exp(t_logp - b_logp)
+    crho = np.minimum(rho_bar, rhos)
+    cs = np.minimum(c_bar, rhos)
+    disc = gamma * (1.0 - dones)
+    vtp1 = np.concatenate([values[1:], bootstrap[None]], axis=0)
+    deltas = crho * (rewards + disc * vtp1 - values)
+    vs_minus_v = np.zeros((T + 1, B), np.float32)
+    for t in range(T - 1, -1, -1):
+        vs_minus_v[t] = deltas[t] + disc[t] * cs[t] * vs_minus_v[t + 1]
+    vs = vs_minus_v[:T] + values
+    vs_tp1 = np.concatenate([vs[1:], bootstrap[None]], axis=0)
+    pg_adv = crho * (rewards + disc * vs_tp1 - values)
+
+    np.testing.assert_allclose(np.asarray(out.vs), vs, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.pg_advantages), pg_adv, rtol=1e-4, atol=1e-5)
