@@ -1,0 +1,102 @@
+"""Benchmark: Atari env-steps/sec/chip (BASELINE.json metric).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline denominator: the north-star is "matching the original 64-node CPU
+cluster's env-steps/sec on one host" (BASELINE.json). The reference published
+no throughput number we could verify (mount empty, BASELINE.json `published`
+== {}); BASELINE.md records the recalled-UNVERIFIED cluster figure of
+~80k agent-steps/sec across 64 nodes for the 21-minute Atari runs. We use
+that 80_000 as the vs_baseline denominator until a verified figure exists.
+
+What is measured: sustained learner train-step throughput on the real chip —
+transitions consumed per second per chip (one transition == one agent-level
+env step: an 84x84x4 uint8 state + action + n-step return, exactly what the
+reference's FIFOQueue feeds per sample). Host->device transfer of fresh uint8
+batches is included so the number reflects the full feed path, not just the
+matmul time. When the fused on-device env path lands, this script switches to
+measuring true emulator-steps/sec.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+BASELINE_ENV_STEPS_PER_SEC = 80_000.0  # recalled 64-node cluster rate, UNVERIFIED
+
+
+def bench_learner(batch_size: int = 1024, steps: int = 30) -> dict:
+    import optax
+
+    from distributed_ba3c_tpu.config import BA3CConfig
+    from distributed_ba3c_tpu.models.a3c import BA3CNet
+    from distributed_ba3c_tpu.parallel.mesh import make_mesh
+    from distributed_ba3c_tpu.parallel.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+
+    n_chips = len(jax.devices())
+    cfg = BA3CConfig(batch_size=batch_size * n_chips)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    optimizer = optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip_norm),
+        optax.adam(cfg.learning_rate, eps=cfg.adam_epsilon),
+    )
+    mesh = make_mesh(num_data=n_chips, num_model=1)
+    state = create_train_state(jax.random.PRNGKey(0), model, cfg, optimizer)
+    step = make_train_step(model, optimizer, cfg, mesh)
+    state = jax.device_put(state, step.state_sharding)
+
+    rng = np.random.default_rng(0)
+    # Pre-generate host batches (double-buffer style: alternate two buffers so
+    # the device never waits on host RNG, but transfer cost stays measured).
+    host_batches = []
+    for _ in range(2):
+        host_batches.append(
+            {
+                "state": rng.integers(
+                    0, 255, (cfg.batch_size, *cfg.state_shape), dtype=np.uint8
+                ),
+                "action": rng.integers(
+                    0, cfg.num_actions, (cfg.batch_size,), dtype=np.int32
+                ),
+                "return": rng.normal(size=(cfg.batch_size,)).astype(np.float32),
+            }
+        )
+
+    def put(b):
+        return {k: jax.device_put(v, step.batch_sharding) for k, v in b.items()}
+
+    # warmup / compile
+    state, metrics = step(state, put(host_batches[0]), cfg.entropy_beta)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step(state, put(host_batches[i % 2]), cfg.entropy_beta)
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+
+    sps = steps * cfg.batch_size / dt
+    per_chip = sps / n_chips
+    return {
+        "metric": "learner_train_samples_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "env-steps/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_ENV_STEPS_PER_SEC, 3),
+    }
+
+
+def main():
+    result = bench_learner()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,8 +13,18 @@ from distributed_ba3c_tpu.ops.returns import (
 )
 from distributed_ba3c_tpu.ops.loss import a3c_loss, A3CLossOut
 from distributed_ba3c_tpu.ops.vtrace import vtrace_returns, VTraceOut
+from distributed_ba3c_tpu.ops.gradproc import (
+    global_norm_clip,
+    grad_summaries,
+    make_optimizer,
+    map_gradient,
+)
 
 __all__ = [
+    "global_norm_clip",
+    "grad_summaries",
+    "make_optimizer",
+    "map_gradient",
     "discounted_returns",
     "discounted_returns_np",
     "n_step_returns",

@@ -58,7 +58,7 @@ def make_optimizer(
     by the caller (the ScheduledHyperParamSetter callback mutates it live).
     """
     return optax.chain(
-        optax.clip_by_global_norm(grad_clip_norm),
+        global_norm_clip(grad_clip_norm),
         optax.inject_hyperparams(optax.adam)(
             learning_rate=learning_rate, eps=adam_epsilon
         ),
