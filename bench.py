@@ -3,20 +3,22 @@
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Baseline denominator: the north-star is "matching the original 64-node CPU
-cluster's env-steps/sec on one host" (BASELINE.json). The reference published
-no throughput number we could verify (mount empty, BASELINE.json `published`
-== {}); BASELINE.md records the recalled-UNVERIFIED cluster figure of
-~80k agent-steps/sec across 64 nodes for the 21-minute Atari runs. We use
-that 80_000 as the vs_baseline denominator until a verified figure exists.
+What is measured: the fused on-device actor+learner loop (envs, rendering,
+policy forward, sampling, n-step returns, loss, grads, Adam — one jitted
+program, distributed_ba3c_tpu/fused/) on pure-JAX Pong, counting AGENT steps
+(each = 4 physics substeps, ALE frameskip parity). This is the path that
+replaces the reference's 64-node CPU cluster: its whole pipeline (ALE procs →
+ZMQ → predictor → FIFOQueue → PS updates, SURVEY.md §3) collapses into this
+one computation.
 
-What is measured: sustained learner train-step throughput on the real chip —
-transitions consumed per second per chip (one transition == one agent-level
-env step: an 84x84x4 uint8 state + action + n-step return, exactly what the
-reference's FIFOQueue feeds per sample). Host->device transfer of fresh uint8
-batches is included so the number reflects the full feed path, not just the
-matmul time. When the fused on-device env path lands, this script switches to
-measuring true emulator-steps/sec.
+Baseline denominator: BASELINE.json's north-star is "matching the original
+64-node CPU cluster's env-steps/sec on one host". The reference published no
+verifiable throughput number (mount empty; BASELINE.json `published` == {});
+BASELINE.md records the recalled-UNVERIFIED figure of ~80k agent-steps/sec
+across the 64-node cluster for the 21-minute runs. vs_baseline uses that
+80_000 until a verified figure exists. (The secondary metric — wall-clock to
+Pong >= 18 — is tracked separately in full training runs' stat.json, not in
+this number.)
 """
 
 from __future__ import annotations
@@ -25,77 +27,54 @@ import json
 import time
 
 import jax
-import numpy as np
 
 BASELINE_ENV_STEPS_PER_SEC = 80_000.0  # recalled 64-node cluster rate, UNVERIFIED
 
 
-def bench_learner(batch_size: int = 1024, steps: int = 30) -> dict:
-    import optax
-
+def bench_fused(n_envs: int = 1024, rollout_len: int = 20, iters: int = 20) -> dict:
     from distributed_ba3c_tpu.config import BA3CConfig
+    from distributed_ba3c_tpu.envs.jaxenv import pong
+    from distributed_ba3c_tpu.fused.loop import create_fused_state, make_fused_step
     from distributed_ba3c_tpu.models.a3c import BA3CNet
+    from distributed_ba3c_tpu.ops.gradproc import make_optimizer
     from distributed_ba3c_tpu.parallel.mesh import make_mesh
-    from distributed_ba3c_tpu.parallel.train_step import (
-        create_train_state,
-        make_train_step,
-    )
 
     n_chips = len(jax.devices())
-    cfg = BA3CConfig(batch_size=batch_size * n_chips)
+    cfg = BA3CConfig(num_actions=pong.num_actions)
     model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
-    optimizer = optax.chain(
-        optax.clip_by_global_norm(cfg.grad_clip_norm),
-        optax.adam(cfg.learning_rate, eps=cfg.adam_epsilon),
+    opt = make_optimizer(cfg.learning_rate, cfg.adam_epsilon, cfg.grad_clip_norm)
+    mesh = make_mesh()
+    step = make_fused_step(model, opt, cfg, mesh, pong, rollout_len=rollout_len)
+    state = create_fused_state(
+        jax.random.PRNGKey(0), model, cfg, opt, pong,
+        n_envs * n_chips, n_shards=n_chips,
     )
-    mesh = make_mesh(num_data=n_chips, num_model=1)
-    state = create_train_state(jax.random.PRNGKey(0), model, cfg, optimizer)
-    step = make_train_step(model, optimizer, cfg, mesh)
-    state = jax.device_put(state, step.state_sharding)
-
-    rng = np.random.default_rng(0)
-    # Pre-generate host batches (double-buffer style: alternate two buffers so
-    # the device never waits on host RNG, but transfer cost stays measured).
-    host_batches = []
-    for _ in range(2):
-        host_batches.append(
-            {
-                "state": rng.integers(
-                    0, 255, (cfg.batch_size, *cfg.state_shape), dtype=np.uint8
-                ),
-                "action": rng.integers(
-                    0, cfg.num_actions, (cfg.batch_size,), dtype=np.int32
-                ),
-                "return": rng.normal(size=(cfg.batch_size,)).astype(np.float32),
-            }
-        )
-
-    def put(b):
-        return {k: jax.device_put(v, step.batch_sharding) for k, v in b.items()}
+    state = step.put(state)
 
     # warmup / compile
-    state, metrics = step(state, put(host_batches[0]), cfg.entropy_beta)
+    state, metrics = step(state, cfg.entropy_beta)
     jax.block_until_ready(metrics)
 
     t0 = time.perf_counter()
-    for i in range(steps):
-        state, metrics = step(state, put(host_batches[i % 2]), cfg.entropy_beta)
+    for _ in range(iters):
+        state, metrics = step(state, cfg.entropy_beta)
     jax.block_until_ready(metrics)
     dt = time.perf_counter() - t0
 
-    sps = steps * cfg.batch_size / dt
-    per_chip = sps / n_chips
+    env_steps = iters * n_envs * n_chips * rollout_len
+    host_rate = env_steps / dt
+    per_chip = host_rate / n_chips
     return {
-        "metric": "learner_train_samples_per_sec_per_chip",
+        "metric": "fused_pong_env_steps_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "env-steps/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_ENV_STEPS_PER_SEC, 3),
+        # north-star compares the HOST-aggregate rate to the 64-node cluster
+        "vs_baseline": round(host_rate / BASELINE_ENV_STEPS_PER_SEC, 3),
     }
 
 
 def main():
-    result = bench_learner()
-    print(json.dumps(result))
+    print(json.dumps(bench_fused()))
 
 
 if __name__ == "__main__":

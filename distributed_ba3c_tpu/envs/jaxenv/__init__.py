@@ -1,0 +1,24 @@
+"""On-device vectorized environments (pure JAX, gymnax-style).
+
+The TPU-native addition the reference never had (SURVEY.md §7 step 10,
+BASELINE.json config #5): env physics and rendering as jit/vmap-able pure
+functions, so thousands of envs step per device inside the SAME compiled
+program as the learner — zero host round-trips, no ZMQ, no pickle, the
+whole actor-learner loop is one XLA computation.
+
+Env functional protocol (unbatched; vmap at the call site):
+    env.reset(key) -> state                       (pytree of arrays)
+    env.step(state, action, key) -> (state, obs uint8 [H,W], reward, done)
+    env.num_actions: int
+Episodes auto-restart on done (same contract as the host player protocol,
+envs/base.py) so rollout scans never branch.
+"""
+
+from distributed_ba3c_tpu.envs.jaxenv import breakout, pong
+
+
+def get_env(name: str):
+    envs = {"pong": pong, "breakout": breakout}
+    if name not in envs:
+        raise ValueError(f"unknown jax env {name!r}; have {sorted(envs)}")
+    return envs[name]

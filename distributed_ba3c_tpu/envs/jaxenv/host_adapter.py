@@ -1,0 +1,64 @@
+"""Host-side player adapter for the pure-JAX envs.
+
+Lets the on-device envs (envs/jaxenv/) serve the HOST actor plane too — a
+SimulatorProcess child or the Evaluator can run `jax:pong` through the same
+player protocol as FakeEnv/ALE (envs/base.py). Forces the CPU backend in the
+child: simulator children must never grab the (single) TPU.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def build_jax_player(idx: int, name: str = "pong", frame_history: int = 4):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    if jax.default_backend() != "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    from distributed_ba3c_tpu.envs.base import RLEnvironment
+    from distributed_ba3c_tpu.envs.jaxenv import get_env
+    from distributed_ba3c_tpu.envs.wrappers import HistoryFramePlayer
+
+    env = get_env(name)
+    step = jax.jit(env.step)
+
+    class _JaxPlayer(RLEnvironment):
+        def __init__(self):
+            self.key = jax.random.PRNGKey(idx)
+            self.state = env.reset(self.key)
+            self.obs = np.asarray(env.render(self.state))
+            self.score = 0.0
+            super().__init__()
+
+        def current_state(self):
+            return self.obs
+
+        def get_action_space_size(self):
+            return env.num_actions
+
+        def action(self, act):
+            self.key, k = jax.random.split(self.key)
+            self.state, obs, r, d = step(self.state, np.int32(act), k)
+            self.obs = np.asarray(obs)
+            r, d = float(r), bool(d)
+            self.score += r
+            if d:
+                self.finish_episode(self.score)
+                self.score = 0.0
+            return r, d
+
+        def restart_episode(self):
+            self.key, k = jax.random.split(self.key)
+            self.state = env.reset(k)
+            self.obs = np.asarray(env.render(self.state))
+            self.score = 0.0
+
+    return HistoryFramePlayer(_JaxPlayer(), frame_history)

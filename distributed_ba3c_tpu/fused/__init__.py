@@ -1,0 +1,23 @@
+"""Fused on-device actor+learner: rollout and update in ONE compiled program.
+
+BASELINE.json config #5 and the performance centerpiece of the rebuild: where
+the reference burns a 64-node CPU cluster shuttling experience over ZMQ and
+gradients over gRPC (SURVEY.md §3.2-3.4), this path keeps everything — env
+physics, rendering, action sampling, n-step returns, loss, psum, Adam — in a
+single jitted XLA computation per iteration. Zero host round-trips; the only
+host traffic is scalar metrics.
+"""
+
+from distributed_ba3c_tpu.fused.loop import (
+    FusedState,
+    create_fused_state,
+    make_fused_step,
+    run_fused_training,
+)
+
+__all__ = [
+    "FusedState",
+    "create_fused_state",
+    "make_fused_step",
+    "run_fused_training",
+]

@@ -1,0 +1,367 @@
+"""The fused rollout+update step and its training loop.
+
+Structure of one fused step (all inside one jit, shard_map'd over the mesh's
+``data`` axis; B envs per device):
+
+    lax.scan over T rollout steps:
+        forward policy on the frame stack  (bf16 convs on the MXU)
+        sample actions (on-device categorical)
+        vmap(env.step): physics + uint8 render for B envs
+        update frame stacks, episode-return accumulators
+    bootstrap value on the final stacks
+    n-step returns (reverse scan, done-masked)   ops/returns.py
+    a3c loss over the [T*B] flat batch           ops/loss.py
+    grads → mean over data axis → Adam update    (the one collective)
+
+The rollout forward runs without gradient tracking; the loss recomputes the
+forward over the collected stacks — standard A2C, and on TPU the recompute is
+cheaper than storing activations (HBM-bandwidth-bound regime).
+
+Actor/learner lag is ZERO here (perfectly on-policy), so the plain A3C loss
+is exact; the V-trace path exists for the lagged ZMQ plane.
+
+RNG layout: ``FusedState.key`` is a [n_shards] typed-key array sharded over
+the data axis — each shard consumes its own stream, so no two devices roll
+identical envs. Episode stats are per-env arrays (sharded with the env
+batch) and psum'd into scalars only inside the metrics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_ba3c_tpu.config import BA3CConfig
+from distributed_ba3c_tpu.models.a3c import BA3CNet
+from distributed_ba3c_tpu.ops.gradproc import grad_summaries, inject_learning_rate
+from distributed_ba3c_tpu.ops.loss import a3c_loss
+from distributed_ba3c_tpu.ops.returns import n_step_returns
+from distributed_ba3c_tpu.parallel.mesh import DATA_AXIS
+from distributed_ba3c_tpu.parallel.train_step import TrainState
+
+
+class FusedState(struct.PyTreeNode):
+    train: TrainState
+    env_state: Any            # batched env pytree, leaves [B_global, ...]
+    obs_stack: jax.Array      # [B_global, H, W, hist] uint8
+    key: jax.Array            # [n_shards] typed PRNG keys, sharded on data axis
+    ep_return: jax.Array      # [B_global] running episode return
+    ep_count: jax.Array       # [B_global] int32 completed episodes per env
+    ep_return_sum: jax.Array  # [B_global] float32 sum of completed returns per env
+
+
+def create_fused_state(
+    rng: jax.Array,
+    model: BA3CNet,
+    cfg: BA3CConfig,
+    optimizer: optax.GradientTransformation,
+    env,
+    n_envs: int,
+    n_shards: int = 1,
+) -> FusedState:
+    """Build the global fused state (host-side; ``jax.device_put`` it with the
+    step's ``state_sharding`` before use)."""
+    from distributed_ba3c_tpu.parallel.train_step import create_train_state
+
+    train = create_train_state(rng, model, cfg, optimizer)
+    keys = jax.random.split(jax.random.fold_in(rng, 1), n_envs)
+    env_state = jax.vmap(env.reset)(keys)
+    obs = jax.vmap(env.render)(env_state)  # [B, H, W]
+    stack = jnp.zeros((n_envs, *obs.shape[1:], cfg.frame_history), jnp.uint8)
+    stack = stack.at[..., -1].set(obs)
+    shard_keys = jax.vmap(
+        lambda i: jax.random.fold_in(jax.random.fold_in(rng, 2), i)
+    )(jnp.arange(n_shards))
+    return FusedState(
+        train=train,
+        env_state=env_state,
+        obs_stack=stack,
+        key=shard_keys,
+        ep_return=jnp.zeros(n_envs, jnp.float32),
+        ep_count=jnp.zeros(n_envs, jnp.int32),
+        ep_return_sum=jnp.zeros(n_envs, jnp.float32),
+    )
+
+
+def make_fused_step(
+    model: BA3CNet,
+    optimizer: optax.GradientTransformation,
+    cfg: BA3CConfig,
+    mesh: Mesh,
+    env,
+    rollout_len: int = 20,
+) -> Callable:
+    """Build fn(state, entropy_beta, lr) -> (state, metrics), fully on-device."""
+
+    def local_step(state: FusedState, entropy_beta, learning_rate):
+        params = state.train.params
+        key = state.key[0]  # this shard's scalar key
+
+        def rollout_body(carry, _):
+            env_state, stack, key, ep_ret, ep_cnt, ep_sum = carry
+            B = stack.shape[0]
+            out = model.apply({"params": params}, stack)
+            key, k_act, k_env = jax.random.split(key, 3)
+            actions = jax.random.categorical(k_act, out.logits, axis=-1).astype(
+                jnp.int32
+            )
+            env_keys = jax.random.split(k_env, B)
+            env_state, obs, reward, done = jax.vmap(env.step)(
+                env_state, actions, env_keys
+            )
+            new_stack = jnp.concatenate([stack[..., 1:], obs[..., None]], axis=-1)
+            # episode bookkeeping (done ⇒ env auto-restarted inside step)
+            ep_ret = ep_ret + reward
+            donef = done.astype(jnp.float32)
+            ep_sum = ep_sum + ep_ret * donef
+            ep_cnt = ep_cnt + done.astype(jnp.int32)
+            ep_ret = ep_ret * (1.0 - donef)
+            # a done frame must not leak history into the new episode
+            new_stack = jnp.where(
+                done[:, None, None, None],
+                jnp.zeros_like(new_stack).at[..., -1].set(obs),
+                new_stack,
+            )
+            ys = (stack, actions, reward, donef)
+            return (env_state, new_stack, key, ep_ret, ep_cnt, ep_sum), ys
+
+        carry0 = (
+            state.env_state,
+            state.obs_stack,
+            key,
+            state.ep_return,
+            state.ep_count,
+            state.ep_return_sum,
+        )
+        (env_state, stack, key, ep_ret, ep_cnt, ep_sum), traj = jax.lax.scan(
+            rollout_body, carry0, None, length=rollout_len
+        )
+        states_t, actions_t, rewards_t, dones_t = traj  # [T, B, ...]
+
+        # bootstrap from the post-rollout stack (no gradient)
+        bootstrap = model.apply({"params": params}, stack).value
+        returns_t = n_step_returns(
+            rewards_t, dones_t, jax.lax.stop_gradient(bootstrap), cfg.gamma
+        )
+
+        T, B = actions_t.shape
+
+        # Gradient accumulation over the T axis: one fwd+bwd per [B]-chunk
+        # inside a scan. Differentiating a single [T*B] forward would hold
+        # every conv activation at once (~29 GB at B=1024, T=20 — exceeds
+        # HBM); chunking bounds activation memory at one timestep's batch
+        # while keeping each matmul MXU-sized. Mean-of-chunk-grads equals the
+        # full-batch gradient (equal chunk sizes).
+        def chunk_grad(p, chunk):
+            states_c, actions_c, returns_c = chunk
+
+            def loss_fn(pp):
+                out = model.apply({"params": pp}, states_c)
+                loss = a3c_loss(
+                    out.logits,
+                    out.value,
+                    actions_c,
+                    returns_c,
+                    entropy_beta=entropy_beta,
+                    value_loss_coef=cfg.value_loss_coef,
+                )
+                return loss.total, loss
+
+            return jax.value_and_grad(loss_fn, has_aux=True)(p)
+
+        def acc_body(carry, chunk):
+            g_acc, aux_acc = carry
+            (_, aux), g = chunk_grad(params, chunk)
+            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+            aux_acc = jax.tree_util.tree_map(jnp.add, aux_acc, aux)
+            return (g_acc, aux_acc), None
+
+        g0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (_, aux0), gfirst = chunk_grad(
+            params, (states_t[0], actions_t[0], returns_t[0])
+        )
+        (grads, aux_sum), _ = jax.lax.scan(
+            acc_body,
+            (jax.tree_util.tree_map(jnp.add, g0, gfirst), aux0),
+            (states_t[1:], actions_t[1:], returns_t[1:]),
+        )
+        grads = jax.tree_util.tree_map(lambda g: g / T, grads)
+        aux = jax.tree_util.tree_map(lambda a: a / T, aux_sum)
+        n_data = jax.lax.axis_size(DATA_AXIS)
+        grads = jax.tree_util.tree_map(lambda g: g / n_data, grads)
+
+        opt_state = inject_learning_rate(state.train.opt_state, learning_rate)
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+
+        new_state = FusedState(
+            train=TrainState(
+                step=state.train.step + 1,
+                params=new_params,
+                opt_state=new_opt_state,
+            ),
+            env_state=env_state,
+            obs_stack=stack,
+            key=key[None],
+            ep_return=ep_ret,
+            ep_count=ep_cnt,
+            ep_return_sum=ep_sum,
+        )
+        metrics = {
+            "loss": aux.total,
+            "policy_loss": aux.policy_loss,
+            "value_loss": aux.value_loss,
+            "entropy": aux.entropy,
+            "pred_value": aux.pred_value,
+            **grad_summaries(grads),
+            "reward_per_step": jnp.mean(rewards_t),
+        }
+        metrics = {k: jax.lax.pmean(v, DATA_AXIS) for k, v in metrics.items()}
+        metrics["episodes"] = jax.lax.psum(jnp.sum(ep_cnt), DATA_AXIS)
+        metrics["episode_return_sum"] = jax.lax.psum(jnp.sum(ep_sum), DATA_AXIS)
+        return new_state, metrics
+
+    batch_spec = P(DATA_AXIS)
+    env_state_struct = jax.eval_shape(env.reset, jax.random.PRNGKey(0))
+    # pytree-prefix specs: train=P() replicates the whole TrainState subtree
+    state_specs = FusedState(
+        train=P(),
+        env_state=jax.tree_util.tree_map(lambda _: batch_spec, env_state_struct),
+        obs_stack=batch_spec,
+        key=P(DATA_AXIS),
+        ep_return=batch_spec,
+        ep_count=batch_spec,
+        ep_return_sum=batch_spec,
+    )
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_specs, P(), P()),
+        out_specs=(state_specs, P()),
+    )
+    jitted = jax.jit(sharded, donate_argnums=(0,))
+
+    def step(state, entropy_beta, learning_rate=None):
+        if learning_rate is None:
+            learning_rate = cfg.learning_rate
+        return jitted(
+            state,
+            jnp.asarray(entropy_beta, jnp.float32),
+            jnp.asarray(learning_rate, jnp.float32),
+        )
+
+    replicated = NamedSharding(mesh, P())
+    batched = NamedSharding(mesh, batch_spec)
+
+    def put(state: FusedState) -> FusedState:
+        """device_put a host FusedState with the step's shardings."""
+        return FusedState(
+            train=jax.device_put(state.train, replicated),
+            env_state=jax.device_put(state.env_state, batched),
+            obs_stack=jax.device_put(state.obs_stack, batched),
+            key=jax.device_put(state.key, batched),
+            ep_return=jax.device_put(state.ep_return, batched),
+            ep_count=jax.device_put(state.ep_count, batched),
+            ep_return_sum=jax.device_put(state.ep_return_sum, batched),
+        )
+
+    step.put = put
+    step.replicated_sharding = replicated
+    step.batch_sharding = batched
+    step.mesh = mesh
+    step.rollout_len = rollout_len
+    return step
+
+
+def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
+    """CLI driver for --trainer=tpu_fused_ba3c (env must be jax:<name>)."""
+    from distributed_ba3c_tpu.envs import jaxenv
+    from distributed_ba3c_tpu.parallel.mesh import make_mesh
+    from distributed_ba3c_tpu.train.checkpoint import CheckpointManager
+    from distributed_ba3c_tpu.utils import logger
+    from distributed_ba3c_tpu.utils.stats import StatHolder
+
+    if not args.env.startswith("jax:"):
+        raise SystemExit("--trainer=tpu_fused_ba3c requires --env jax:<name>")
+    env = jaxenv.get_env(args.env.split(":", 1)[1])
+    cfg = cfg.replace(num_actions=env.num_actions)
+    model = dataclasses.replace(model, num_actions=env.num_actions)
+
+    mesh = make_mesh(num_data=args.mesh_data, num_model=1)
+    n_data = mesh.shape[DATA_AXIS]
+    rollout_len = args.rollout_len
+    envs_per_device = max(1, cfg.batch_size // rollout_len)
+    n_envs = envs_per_device * n_data
+    step = make_fused_step(model, optimizer, cfg, mesh, env, rollout_len)
+    state = create_fused_state(
+        jax.random.PRNGKey(0), model, cfg, optimizer, env, n_envs, n_shards=n_data
+    )
+    state = step.put(state)
+
+    holder = StatHolder(args.logdir)
+    ckpt = CheckpointManager(f"{args.logdir}/checkpoints")
+    logger.set_logger_dir(args.logdir)
+    samples_per_iter = n_envs * rollout_len
+    logger.info(
+        "fused training: %d envs x %d rollout = %d samples/iter on %d devices",
+        n_envs,
+        rollout_len,
+        samples_per_iter,
+        n_data,
+    )
+
+    best = -np.inf
+    for epoch in range(1, args.max_epoch + 1):
+        t0 = time.time()
+        metrics = None
+        for _ in range(args.steps_per_epoch):
+            state, metrics = step(state, cfg.entropy_beta)
+        metrics = {k: float(v) for k, v in metrics.items()}
+        dt = time.time() - t0
+        fps = args.steps_per_epoch * samples_per_iter / dt
+        mean_ret = (
+            metrics["episode_return_sum"] / metrics["episodes"]
+            if metrics["episodes"] > 0
+            else float("nan")
+        )
+        # reset the per-env episode accumulators for the next window
+        state = state.replace(
+            ep_count=jax.device_put(
+                jnp.zeros(n_envs, jnp.int32), step.batch_sharding
+            ),
+            ep_return_sum=jax.device_put(
+                jnp.zeros(n_envs, jnp.float32), step.batch_sharding
+            ),
+        )
+        holder.add_stat("epoch", epoch)
+        holder.add_stat("fps", fps)
+        if np.isfinite(mean_ret):
+            holder.add_stat("mean_score", mean_ret)
+        for k in ("loss", "policy_loss", "value_loss", "entropy", "grad_norm"):
+            holder.add_stat(k, metrics[k])
+        holder.finalize()
+        logger.info(
+            "epoch %d | env-steps/s %.0f | mean_score %.2f (%d eps) | loss %.4f entropy %.3f",
+            epoch,
+            fps,
+            mean_ret,
+            int(metrics["episodes"]),
+            metrics["loss"],
+            metrics["entropy"],
+        )
+        ckpt.save(jax.device_get(state.train), int(state.train.step))
+        if np.isfinite(mean_ret) and mean_ret > best:
+            best = mean_ret
+            ckpt.mark_best(int(state.train.step), mean_ret)
+    return 0
