@@ -1,0 +1,407 @@
+// Batched Atari-like environment core (native).
+//
+// The reference's principal native component is ALE — a C++ Atari 2600
+// emulator whose per-frame cost dominates the actor side (SURVEY.md §2.10).
+// This is its TPU-rebuild equivalent: game physics, frameskip and 84x84
+// grayscale rendering in C++, with a BATCHED step API so one host process
+// drives hundreds of envs per call (the reference paid one process per env).
+//
+// Game semantics intentionally mirror distributed_ba3c_tpu/envs/jaxenv/
+// (pong.py, breakout.py): same geometry constants, action maps, reward
+// structure (first-to-21 Pong; 6x18 bricks / 5 lives / row-scored Breakout),
+// so policies transfer between the on-device JAX envs and this host-side
+// core, and the Python tests can assert semantic parity.
+//
+// No external dependencies (the image has no zmq.h/msgpack.h): transport is
+// thin pyzmq glue in distributed_ba3c_tpu/envs/native.py; every hot cycle
+// (step physics + render) happens here.
+//
+// Build: make -C cpp   (g++ -O3 -shared -fPIC)
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kH = 84, kW = 84;
+constexpr int kFrameSkip = 4;
+
+// ---------------------------------------------------------------- Pong ----
+namespace pong {
+constexpr float kPaddleH = 0.16f, kPaddleW = 0.02f;
+constexpr float kAgentX = 0.95f, kOppX = 0.05f;
+constexpr float kBallR = 0.015f;
+constexpr float kPaddleSpeed = 0.05f, kOppSpeed = 0.035f, kBallSpeed = 0.04f;
+constexpr int kWinScore = 21;
+constexpr int kNumActions = 6;
+}  // namespace pong
+
+// ------------------------------------------------------------ Breakout ----
+namespace brk {
+constexpr int kRows = 6, kCols = 18;
+constexpr float kBrickTop = 0.15f, kBrickH = 0.03f;
+constexpr float kPaddleY = 0.92f, kPaddleH = 0.02f, kPaddleW = 0.08f;
+constexpr float kBallR = 0.012f;
+constexpr float kPaddleSpeed = 0.04f, kBallSpeed = 0.035f;
+constexpr int kLives = 5;
+constexpr int kMaxT = 10000;
+constexpr int kNumActions = 4;
+constexpr float kRowPoints[kRows] = {7.f, 7.f, 4.f, 4.f, 1.f, 1.f};
+}  // namespace brk
+
+struct StepOut {
+  float reward = 0.f;
+  bool done = false;
+};
+
+class Env {
+ public:
+  virtual ~Env() = default;
+  virtual void Reset() = 0;
+  virtual StepOut Step(int action) = 0;  // one agent step (kFrameSkip ticks)
+  virtual void Render(uint8_t* obs) const = 0;  // [kH * kW]
+  virtual int NumActions() const = 0;
+};
+
+class PongEnv : public Env {
+ public:
+  explicit PongEnv(uint64_t seed) : rng_(seed) { Reset(); }
+
+  void Reset() override {
+    agent_y_ = opp_y_ = 0.5f;
+    agent_score_ = opp_score_ = 0;
+    Serve(/*towards_agent=*/true);
+  }
+
+  StepOut Step(int action) override {
+    float move = 0.f;
+    if (action == 2 || action == 4) move = -1.f;
+    if (action == 3 || action == 5) move = 1.f;
+    StepOut out;
+    for (int i = 0; i < kFrameSkip; ++i) out.reward += Substep(move);
+    if (agent_score_ >= pong::kWinScore || opp_score_ >= pong::kWinScore) {
+      out.done = true;
+      Reset();
+    }
+    return out;
+  }
+
+  void Render(uint8_t* obs) const override {
+    std::memset(obs, 0, kH * kW);
+    // walls
+    for (int x = 0; x < kW; ++x) {
+      obs[0 * kW + x] = obs[1 * kW + x] = 80;
+      obs[(kH - 1) * kW + x] = obs[(kH - 2) * kW + x] = 80;
+    }
+    DrawRect(obs, bx_, by_, pong::kBallR, pong::kBallR, 255);
+    DrawRect(obs, pong::kAgentX, agent_y_, pong::kPaddleW, pong::kPaddleH / 2, 255);
+    DrawRect(obs, pong::kOppX, opp_y_, pong::kPaddleW, pong::kPaddleH / 2, 255);
+  }
+
+  int NumActions() const override { return pong::kNumActions; }
+
+  int agent_score() const { return agent_score_; }
+  int opp_score() const { return opp_score_; }
+
+ private:
+  void Serve(bool towards_agent) {
+    std::uniform_real_distribution<float> ang(-0.7f, 0.7f);
+    std::uniform_real_distribution<float> jit(-0.1f, 0.1f);
+    float a = ang(rng_);
+    bx_ = 0.5f;
+    by_ = 0.5f + jit(rng_);
+    vx_ = pong::kBallSpeed * std::cos(a) * (towards_agent ? 1.f : -1.f);
+    vy_ = pong::kBallSpeed * std::sin(a);
+  }
+
+  float Substep(float move) {
+    namespace P = pong;
+    agent_y_ = std::clamp(agent_y_ + move * P::kPaddleSpeed, P::kPaddleH / 2,
+                          1.f - P::kPaddleH / 2);
+    float opp_dy = std::clamp(by_ - opp_y_, -P::kOppSpeed, P::kOppSpeed);
+    opp_y_ = std::clamp(opp_y_ + opp_dy, P::kPaddleH / 2, 1.f - P::kPaddleH / 2);
+
+    bx_ += vx_;
+    by_ += vy_;
+    if (by_ < P::kBallR || by_ > 1.f - P::kBallR) {
+      vy_ = -vy_;
+      by_ = std::clamp(by_, P::kBallR, 1.f - P::kBallR);
+    }
+    // agent paddle (right, ball moving right)
+    if (vx_ > 0 && bx_ >= P::kAgentX - P::kPaddleW &&
+        std::fabs(by_ - agent_y_) <= P::kPaddleH / 2 + P::kBallR) {
+      float off = (by_ - agent_y_) / (P::kPaddleH / 2);
+      vx_ = -vx_;
+      vy_ = P::kBallSpeed * 0.9f * off;
+      bx_ = P::kAgentX - P::kPaddleW - P::kBallR;
+    }
+    // opponent paddle (left, ball moving left)
+    if (vx_ < 0 && bx_ <= P::kOppX + P::kPaddleW &&
+        std::fabs(by_ - opp_y_) <= P::kPaddleH / 2 + P::kBallR) {
+      float off = (by_ - opp_y_) / (P::kPaddleH / 2);
+      vx_ = -vx_;
+      vy_ = P::kBallSpeed * 0.9f * off;
+      bx_ = P::kOppX + P::kPaddleW + P::kBallR;
+    }
+    float reward = 0.f;
+    if (bx_ <= 0.f) {  // opponent missed
+      reward = 1.f;
+      ++agent_score_;
+      Serve(/*towards_agent=*/false);
+    } else if (bx_ >= 1.f) {  // agent missed
+      reward = -1.f;
+      ++opp_score_;
+      Serve(/*towards_agent=*/true);
+    }
+    return reward;
+  }
+
+  static void DrawRect(uint8_t* obs, float cx, float cy, float hw, float hh,
+                       uint8_t v) {
+    int x0 = std::max(0, (int)std::floor((cx - hw) * kW));
+    int x1 = std::min(kW - 1, (int)std::ceil((cx + hw) * kW));
+    int y0 = std::max(0, (int)std::floor((cy - hh) * kH));
+    int y1 = std::min(kH - 1, (int)std::ceil((cy + hh) * kH));
+    for (int y = y0; y <= y1; ++y)
+      for (int x = x0; x <= x1; ++x) obs[y * kW + x] = v;
+  }
+
+  std::mt19937_64 rng_;
+  float bx_, by_, vx_, vy_, agent_y_, opp_y_;
+  int agent_score_, opp_score_;
+};
+
+class BreakoutEnv : public Env {
+ public:
+  explicit BreakoutEnv(uint64_t seed) : rng_(seed) { Reset(); }
+
+  void Reset() override {
+    paddle_x_ = 0.5f;
+    bx_ = 0.5f;
+    by_ = brk::kPaddleY - 0.05f;
+    vx_ = vy_ = 0.f;
+    lives_ = brk::kLives;
+    in_play_ = false;
+    t_ = 0;
+    std::fill(std::begin(bricks_), std::end(bricks_), true);
+  }
+
+  StepOut Step(int action) override {
+    float move = action == 2 ? 1.f : action == 3 ? -1.f : 0.f;
+    bool fire = action == 1;
+    StepOut out;
+    for (int i = 0; i < kFrameSkip; ++i) out.reward += Substep(move, fire);
+    ++t_;
+    if (lives_ <= 0 || t_ >= brk::kMaxT) {
+      out.done = true;
+      Reset();
+    }
+    return out;
+  }
+
+  void Render(uint8_t* obs) const override {
+    namespace B = brk;
+    std::memset(obs, 0, kH * kW);
+    for (int x = 0; x < kW; ++x) obs[0 * kW + x] = obs[1 * kW + x] = 80;
+    // bricks
+    for (int r = 0; r < B::kRows; ++r) {
+      int y0 = (int)std::floor((B::kBrickTop + r * B::kBrickH) * kH);
+      int y1 = (int)std::floor((B::kBrickTop + (r + 1) * B::kBrickH) * kH) - 1;
+      for (int c = 0; c < B::kCols; ++c) {
+        if (!bricks_[r * B::kCols + c]) continue;
+        int x0 = c * kW / B::kCols;
+        int x1 = (c + 1) * kW / B::kCols - 1;
+        for (int y = std::max(0, y0); y <= std::min(kH - 1, y1); ++y)
+          for (int x = x0; x <= x1; ++x) obs[y * kW + x] = 180;
+      }
+    }
+    // ball + paddle
+    auto draw = [&](float cx, float cy, float hw, float hh, uint8_t v) {
+      int x0 = std::max(0, (int)std::floor((cx - hw) * kW));
+      int x1 = std::min(kW - 1, (int)std::ceil((cx + hw) * kW));
+      int y0 = std::max(0, (int)std::floor((cy - hh) * kH));
+      int y1 = std::min(kH - 1, (int)std::ceil((cy + hh) * kH));
+      for (int y = y0; y <= y1; ++y)
+        for (int x = x0; x <= x1; ++x) obs[y * kW + x] = v;
+    };
+    draw(bx_, by_, B::kBallR, B::kBallR, 255);
+    draw(paddle_x_, B::kPaddleY, B::kPaddleW / 2, B::kPaddleH, 255);
+  }
+
+  int NumActions() const override { return brk::kNumActions; }
+  int lives() const { return lives_; }
+  int bricks_left() const {
+    int n = 0;
+    for (bool b : bricks_) n += b;
+    return n;
+  }
+
+ private:
+  float Substep(float move, bool fire) {
+    namespace B = brk;
+    paddle_x_ = std::clamp(paddle_x_ + move * B::kPaddleSpeed, B::kPaddleW / 2,
+                           1.f - B::kPaddleW / 2);
+    if (!in_play_) {
+      bx_ = paddle_x_;
+      by_ = B::kPaddleY - 0.05f;
+      if (fire) {
+        std::uniform_real_distribution<float> ang(0.25f * (float)M_PI,
+                                                  0.75f * (float)M_PI);
+        float a = ang(rng_);
+        vx_ = B::kBallSpeed * std::cos(a);
+        vy_ = -B::kBallSpeed * std::sin(a);
+        in_play_ = true;
+      }
+      return 0.f;
+    }
+    bx_ += vx_;
+    by_ += vy_;
+    if (bx_ < B::kBallR || bx_ > 1.f - B::kBallR) {
+      vx_ = -vx_;
+      bx_ = std::clamp(bx_, B::kBallR, 1.f - B::kBallR);
+    }
+    if (by_ < B::kBallR) {
+      vy_ = -vy_;
+      by_ = B::kBallR;
+    }
+    // paddle
+    if (vy_ > 0 && by_ >= B::kPaddleY - B::kPaddleH &&
+        std::fabs(bx_ - paddle_x_) <= B::kPaddleW / 2 + B::kBallR) {
+      float off = (bx_ - paddle_x_) / (B::kPaddleW / 2);
+      vx_ = B::kBallSpeed * off;
+      vy_ = -std::fabs(vy_);
+      by_ = B::kPaddleY - B::kPaddleH - B::kBallR;
+    }
+    // bricks
+    float reward = 0.f;
+    int row = (int)std::floor((by_ - B::kBrickTop) / B::kBrickH);
+    int col = (int)std::floor(bx_ * B::kCols);
+    if (row >= 0 && row < B::kRows && col >= 0 && col < B::kCols &&
+        bricks_[row * B::kCols + col]) {
+      bricks_[row * B::kCols + col] = false;
+      reward = B::kRowPoints[row];
+      // reflect AND expel (see jaxenv/breakout.py: the drilling bug)
+      bool from_below = vy_ < 0;
+      by_ = from_below ? B::kBrickTop + (row + 1) * B::kBrickH + B::kBallR
+                       : B::kBrickTop + row * B::kBrickH - B::kBallR;
+      vy_ = -vy_;
+      if (bricks_left() == 0)
+        std::fill(std::begin(bricks_), std::end(bricks_), true);
+    }
+    // ball lost
+    if (by_ >= 1.f - 1e-6f) {
+      --lives_;
+      in_play_ = false;
+      vx_ = vy_ = 0.f;
+      bx_ = paddle_x_;
+      by_ = B::kPaddleY - 0.05f;
+    }
+    return reward;
+  }
+
+  std::mt19937_64 rng_;
+  float bx_, by_, vx_, vy_, paddle_x_;
+  bool bricks_[brk::kRows * brk::kCols];
+  int lives_, t_;
+  bool in_play_;
+};
+
+// ------------------------------------------------------------- batched ----
+class BatchedEnv {
+ public:
+  BatchedEnv(const std::string& name, int n, uint64_t seed) {
+    for (int i = 0; i < n; ++i) {
+      if (name == "pong")
+        envs_.emplace_back(new PongEnv(seed + i));
+      else if (name == "breakout")
+        envs_.emplace_back(new BreakoutEnv(seed + i));
+      else
+        envs_.clear();
+      if (envs_.empty()) break;
+    }
+  }
+
+  bool ok() const { return !envs_.empty(); }
+  int size() const { return (int)envs_.size(); }
+  int num_actions() const { return envs_[0]->NumActions(); }
+
+  void ResetAll(uint8_t* obs) {
+    for (size_t i = 0; i < envs_.size(); ++i) {
+      envs_[i]->Reset();
+      envs_[i]->Render(obs + i * kH * kW);
+    }
+  }
+
+  // actions[n] -> obs[n*84*84], rewards[n], dones[n]
+  void StepBatch(const int32_t* actions, uint8_t* obs, float* rewards,
+                 uint8_t* dones) {
+    const int n = (int)envs_.size();
+    const int hw = kH * kW;
+    auto work = [&](int lo, int hi) {
+      for (int i = lo; i < hi; ++i) {
+        StepOut out = envs_[i]->Step(actions[i]);
+        rewards[i] = out.reward;
+        dones[i] = out.done ? 1 : 0;
+        envs_[i]->Render(obs + (size_t)i * hw);
+      }
+    };
+    const int kThreadThreshold = 64;
+    if (n < kThreadThreshold) {
+      work(0, n);
+      return;
+    }
+    int nt = std::min<int>(std::thread::hardware_concurrency(), 8);
+    std::vector<std::thread> threads;
+    int chunk = (n + nt - 1) / nt;
+    for (int t = 0; t < nt; ++t) {
+      int lo = t * chunk, hi = std::min(n, lo + chunk);
+      if (lo < hi) threads.emplace_back(work, lo, hi);
+    }
+    for (auto& th : threads) th.join();
+  }
+
+ private:
+  std::vector<std::unique_ptr<Env>> envs_;
+};
+
+}  // namespace
+
+// ------------------------------------------------------------- C API ------
+extern "C" {
+
+void* ba3c_env_create(const char* name, int n, uint64_t seed) {
+  auto* b = new BatchedEnv(name, n, seed);
+  if (!b->ok()) {
+    delete b;
+    return nullptr;
+  }
+  return b;
+}
+
+void ba3c_env_destroy(void* handle) { delete (BatchedEnv*)handle; }
+
+int ba3c_env_num_actions(void* handle) {
+  return ((BatchedEnv*)handle)->num_actions();
+}
+
+int ba3c_env_size(void* handle) { return ((BatchedEnv*)handle)->size(); }
+
+void ba3c_env_reset(void* handle, uint8_t* obs) {
+  ((BatchedEnv*)handle)->ResetAll(obs);
+}
+
+void ba3c_env_step(void* handle, const int32_t* actions, uint8_t* obs,
+                   float* rewards, uint8_t* dones) {
+  ((BatchedEnv*)handle)->StepBatch(actions, obs, rewards, dones);
+}
+
+int ba3c_obs_height() { return kH; }
+int ba3c_obs_width() { return kW; }
+}

@@ -1,0 +1,337 @@
+"""CLI assembly: flags → wired-up training/eval run.
+
+Reference equivalent: ``src/train.py`` ``main``/``get_config`` (SURVEY.md §2.1
+#1, §1 L7). The flag surface mirrors the reference's
+(``--job_name/--task_index/--ps_hosts/--worker_hosts`` cluster flags, the
+hyperparameter flags, ``--load``, ``--task``), and the trainer-selection slot
+BASELINE.json pins is here: ``--trainer=tpu_sync_ba3c`` (default) selects the
+mesh-sharded synchronous learner; ``--trainer=tpu_vtrace_ba3c`` the V-trace
+off-policy variant.
+
+PS-compat note: with the parameter-server plane gone (gradients are a psum
+over ICI, SURVEY.md §2.12), ``--job_name ps`` is accepted and exits
+immediately with an explanatory message — cluster launch scripts that spawn
+ps tasks keep working, the ps tasks just have nothing to host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import queue
+from typing import Optional
+
+from distributed_ba3c_tpu.config import BA3CConfig
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="TPU-native Distributed-BA3C",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter,
+    )
+    # -- reference cluster-spec surface (SURVEY.md §1 L7) ------------------
+    p.add_argument("--job_name", choices=["ps", "worker"], default="worker")
+    p.add_argument("--task_index", type=int, default=0)
+    p.add_argument("--ps_hosts", default="", help="accepted for CLI compat; unused (no parameter servers on TPU)")
+    p.add_argument("--worker_hosts", default="", help="comma-separated worker host list (multi-host DCN bootstrap)")
+    # -- trainer selection slot (BASELINE.json gate) -----------------------
+    p.add_argument(
+        "--trainer",
+        default="tpu_sync_ba3c",
+        choices=["tpu_sync_ba3c", "tpu_vtrace_ba3c", "tpu_fused_ba3c"],
+        help="learner backend: sync psum A2C, V-trace off-policy, or fully on-device fused rollout+update",
+    )
+    # -- run mode ----------------------------------------------------------
+    p.add_argument("--task", default="train", choices=["train", "eval", "play"])
+    p.add_argument("--env", default="fake", help="fake | jax:<name> (on-device env, e.g. jax:pong) | zmq:<addr> (external env server)")
+    p.add_argument("--load", default=None, help="checkpoint dir to resume from")
+    p.add_argument("--logdir", default="train_log/ba3c")
+    # -- hyperparams (reference argparse defaults, SURVEY.md §2.9) ---------
+    p.add_argument("--learning_rate", type=float, default=None)
+    p.add_argument("--entropy_beta", type=float, default=None)
+    p.add_argument("--gamma", type=float, default=None)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--local_time_max", type=int, default=None)
+    p.add_argument("--simulator_procs", type=int, default=None)
+    p.add_argument("--predict_batch_size", type=int, default=None)
+    p.add_argument("--predictor_threads", type=int, default=None)
+    p.add_argument("--fc_units", type=int, default=None)
+    p.add_argument("--image_size", type=int, default=None, help="square observation size")
+    p.add_argument("--frame_history", type=int, default=None)
+    p.add_argument("--grad_clip_norm", type=float, default=None)
+    p.add_argument("--adam_epsilon", type=float, default=None)
+    # -- loop shape --------------------------------------------------------
+    p.add_argument("--steps_per_epoch", type=int, default=1000)
+    p.add_argument("--max_epoch", type=int, default=100)
+    p.add_argument("--nr_eval", type=int, default=8)
+    p.add_argument("--eval_every", type=int, default=1, help="epochs between Evaluator runs")
+    p.add_argument("--num_actions", type=int, default=4)
+    p.add_argument("--mesh_data", type=int, default=None, help="data-axis size (defaults to all devices)")
+    p.add_argument("--publish_every", type=int, default=1)
+    p.add_argument("--rollout_len", type=int, default=20, help="fused-trainer rollout length per update")
+    p.add_argument("--profiler_port", type=int, default=0, help="start jax.profiler server on this port (0=off)")
+    return p
+
+
+def build_config(args) -> BA3CConfig:
+    cfg = BA3CConfig()
+    over = {}
+    for f in (
+        "learning_rate entropy_beta gamma batch_size local_time_max "
+        "simulator_procs predict_batch_size predictor_threads fc_units "
+        "frame_history grad_clip_norm adam_epsilon"
+    ).split():
+        v = getattr(args, f)
+        if v is not None:
+            over[f] = v
+    if args.image_size is not None:
+        over["image_size"] = (args.image_size, args.image_size)
+    over["num_actions"] = args.num_actions
+    return cfg.replace(**over)
+
+
+def _build_player_factory(args, cfg: BA3CConfig):
+    if args.env == "fake" or args.env.startswith("fake:"):
+        from distributed_ba3c_tpu.envs.fake import build_fake_player
+
+        return functools.partial(
+            build_fake_player,
+            image_size=cfg.image_size,
+            frame_history=cfg.frame_history,
+            num_actions=cfg.num_actions,
+        )
+    if args.env.startswith("jax:"):
+        try:
+            from distributed_ba3c_tpu.envs.jaxenv.host_adapter import (
+                build_jax_player,
+            )
+        except ImportError as e:
+            raise SystemExit(
+                f"--env {args.env}: on-device env module unavailable ({e})"
+            )
+        return functools.partial(
+            build_jax_player,
+            name=args.env.split(":", 1)[1],
+            frame_history=cfg.frame_history,
+        )
+    if args.env.startswith("cpp:"):
+        from distributed_ba3c_tpu.envs import native
+
+        if not native.available():
+            raise SystemExit(
+                f"--env {args.env}: native core not built — run `make -C cpp`"
+            )
+        return functools.partial(
+            native.build_cpp_player,
+            name=args.env.split(":", 1)[1],
+            frame_history=cfg.frame_history,
+        )
+    if args.env.startswith("zmq:"):
+        # external env server (e.g. the C++ batched Atari server) already
+        # speaks the simulator wire protocol — there is no in-process player
+        # to build; sims are remote.
+        raise SystemExit(
+            "--env zmq:<addr>: external env servers connect directly to the "
+            "master pipes; pass their address via --worker_hosts instead of "
+            "--env (see cpp/env_server)"
+        )
+    raise ValueError(f"unknown --env {args.env!r}")
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = make_parser().parse_args(argv)
+
+    if args.job_name == "ps":
+        print(
+            "ps job is obsolete on TPU: parameters are replicated in HBM and "
+            "gradients ride a psum over ICI (no parameter servers). Exiting."
+        )
+        return 0
+
+    import jax
+
+    # Honor JAX_PLATFORMS even when a sitecustomize force-registers a TPU
+    # plugin and overrides the env var (this container's axon setup does).
+    _plat = os.environ.get("JAX_PLATFORMS", "")
+    if _plat and "," not in _plat:
+        jax.config.update("jax_platforms", _plat)
+
+    from distributed_ba3c_tpu.models.a3c import BA3CNet
+    from distributed_ba3c_tpu.ops.gradproc import make_optimizer
+    from distributed_ba3c_tpu.parallel.mesh import make_mesh
+    from distributed_ba3c_tpu.parallel.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+    from distributed_ba3c_tpu.utils import logger
+
+    cfg = build_config(args)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    optimizer = make_optimizer(
+        cfg.learning_rate, cfg.adam_epsilon, cfg.grad_clip_norm
+    )
+
+    if args.profiler_port:
+        jax.profiler.start_server(args.profiler_port)
+        logger.info("jax profiler server on :%d", args.profiler_port)
+
+    if args.trainer == "tpu_fused_ba3c":
+        return _run_fused(args, cfg, model, optimizer)
+
+    state = create_train_state(jax.random.PRNGKey(0), model, cfg, optimizer)
+
+    if args.task in ("eval", "play"):
+        return _run_eval(args, cfg, model, state)
+
+    mesh = make_mesh(num_data=args.mesh_data, num_model=1)
+
+    from distributed_ba3c_tpu.actors.master import BA3CSimulatorMaster
+    from distributed_ba3c_tpu.actors.simulator import (
+        SimulatorProcess,
+        default_pipes,
+    )
+    from distributed_ba3c_tpu.actors.vtrace_master import VTraceSimulatorMaster
+    from distributed_ba3c_tpu.data.dataflow import RolloutFeed, TrainFeed
+    from distributed_ba3c_tpu.parallel.vtrace_step import make_vtrace_train_step
+    from distributed_ba3c_tpu.predict.server import BatchedPredictor
+    from distributed_ba3c_tpu.train.callbacks import (
+        Evaluator,
+        HumanHyperParamSetter,
+        MaxSaver,
+        ModelSaver,
+        PeriodicTrigger,
+        StartProcOrThread,
+        StatPrinter,
+    )
+    from distributed_ba3c_tpu.train.trainer import Trainer, TrainLoopConfig
+
+    build_player = _build_player_factory(args, cfg)
+    predictor = BatchedPredictor(
+        model,
+        state.params,
+        batch_size=cfg.predict_batch_size,
+        num_threads=cfg.predictor_threads,
+    )
+    c2s, s2c = default_pipes()
+    score_q: queue.Queue = queue.Queue(maxsize=4096)
+    n_data = mesh.shape["data"]
+    if args.trainer == "tpu_vtrace_ba3c":
+        step = make_vtrace_train_step(model, optimizer, cfg, mesh)
+        master = VTraceSimulatorMaster(
+            c2s,
+            s2c,
+            predictor,
+            unroll_len=cfg.local_time_max,
+            score_queue=score_q,
+        )
+        # segments per batch: ~batch_size transitions, divisible by data axis
+        n_seg = max(1, cfg.batch_size // cfg.local_time_max)
+        n_seg = max(n_data, (n_seg // n_data) * n_data)
+        feed = RolloutFeed(master.queue, n_seg)
+        samples_per_step = n_seg * cfg.local_time_max
+    else:
+        step = make_train_step(model, optimizer, cfg, mesh)
+        master = BA3CSimulatorMaster(
+            c2s,
+            s2c,
+            predictor,
+            gamma=cfg.gamma,
+            local_time_max=cfg.local_time_max,
+            score_queue=score_q,
+        )
+        feed = TrainFeed(master.queue, cfg.batch_size)
+        samples_per_step = cfg.batch_size
+    if args.env.startswith("cpp:"):
+        # batched native servers: each process hosts up to 16 envs in lockstep
+        from distributed_ba3c_tpu.envs import native
+
+        game = args.env.split(":", 1)[1]
+        total = cfg.simulator_procs
+        per = min(16, total)
+        procs = [
+            native.CppEnvServerProcess(
+                i,
+                c2s,
+                s2c,
+                game=game,
+                n_envs=min(per, total - i * per),
+                frame_history=cfg.frame_history,
+            )
+            for i in range((total + per - 1) // per)
+        ]
+    else:
+        procs = [
+            SimulatorProcess(i, c2s, s2c, build_player)
+            for i in range(cfg.simulator_procs)
+        ]
+
+    # Order matters: Evaluator adds its stats BEFORE StatPrinter finalizes the
+    # epoch record, and MaxSaver reads last_mean_score set by StatPrinter.
+    callbacks = [
+        StartProcOrThread([predictor, master, feed] + procs),
+        HumanHyperParamSetter("learning_rate"),
+        PeriodicTrigger(
+            Evaluator(args.nr_eval, build_player), every_k_epochs=args.eval_every
+        ),
+        StatPrinter(),
+        ModelSaver(),
+        MaxSaver(),
+    ]
+    trainer = Trainer(
+        TrainLoopConfig(
+            steps_per_epoch=args.steps_per_epoch,
+            max_epoch=args.max_epoch,
+            log_dir=args.logdir,
+            publish_every=args.publish_every,
+        ),
+        cfg,
+        step,
+        state,
+        feed,
+        callbacks,
+        predictor=predictor,
+        score_queue=score_q,
+        samples_per_step=samples_per_step,
+    )
+    if args.load:
+        trainer.restore(args.load)
+    trainer.train()
+    return 0
+
+
+def _run_eval(args, cfg, model, state) -> int:
+    import jax
+
+    from distributed_ba3c_tpu.predict.server import BatchedPredictor
+    from distributed_ba3c_tpu.train.checkpoint import CheckpointManager
+    from distributed_ba3c_tpu.train.eval import eval_model
+    from distributed_ba3c_tpu.utils import logger
+
+    if args.load:
+        mgr = CheckpointManager(args.load)
+        state = mgr.restore(jax.device_get(state))
+    predictor = BatchedPredictor(
+        model, state.params, batch_size=max(args.nr_eval, 1), greedy=True
+    )
+    build_player = _build_player_factory(args, cfg)
+
+    def predict(states):
+        actions, _, _ = predictor.predict_batch(states)
+        return actions
+
+    mean, mx = eval_model(predict, build_player, args.nr_eval)
+    logger.info("eval over %d episodes: mean=%.2f max=%.2f", args.nr_eval, mean, mx)
+    print(f"mean_score={mean:.3f} max_score={mx:.3f}")
+    return 0
+
+
+def _run_fused(args, cfg, model, optimizer) -> int:
+    try:
+        from distributed_ba3c_tpu.fused.loop import run_fused_training
+    except ImportError:
+        raise SystemExit(
+            "--trainer=tpu_fused_ba3c requires the on-device env module "
+            "(distributed_ba3c_tpu.fused); not available in this build"
+        )
+    return run_fused_training(args, cfg, model, optimizer)

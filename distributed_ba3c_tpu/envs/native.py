@@ -1,0 +1,255 @@
+"""ctypes binding for the C++ batched env core + ZMQ env-server process.
+
+Reference equivalent: the ALE C++ emulator + its Python binding
+(``ale_python_interface``/``atari_py``, SURVEY.md §2.10) — here the native
+core is ``cpp/env_core.cc`` (build: ``make -C cpp``), exposing a BATCHED
+step API so one process drives dozens of envs per call instead of the
+reference's one-ALE-per-process layout.
+
+Three integration surfaces:
+- :class:`CppBatchedEnv` — raw batched stepper (numpy in/out, zero copies
+  beyond the ctypes call).
+- :func:`build_cpp_player` — single-env player (envs/base.py protocol) for
+  wrappers/eval/SimulatorProcess parity paths.
+- :class:`CppEnvServerProcess` — one OS process hosting B envs in lockstep,
+  speaking the simulator wire protocol over ZMQ with one DEALER identity per
+  env (the master cannot tell it apart from B SimulatorProcesses). Transport
+  is thin pyzmq glue — the image ships no zmq.h, so the native side stays
+  dependency-free and every hot cycle (physics + render) is C++.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import multiprocessing as mp
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "cpp",
+    "libba3c_env.so",
+)
+
+_lib = None
+
+
+def _try_build() -> bool:
+    """Attempt `make -C cpp` once (the .so is a build artifact, not committed)."""
+    import subprocess
+
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.dirname(_LIB_PATH)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return False
+    return os.path.isfile(_LIB_PATH)
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        if not os.path.isfile(_LIB_PATH) and not _try_build():
+            raise ImportError(
+                f"native env core not built: {_LIB_PATH} missing (run `make -C cpp`)"
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.ba3c_env_create.restype = ctypes.c_void_p
+        lib.ba3c_env_create.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_uint64]
+        lib.ba3c_env_destroy.argtypes = [ctypes.c_void_p]
+        lib.ba3c_env_num_actions.argtypes = [ctypes.c_void_p]
+        lib.ba3c_env_num_actions.restype = ctypes.c_int
+        lib.ba3c_env_size.argtypes = [ctypes.c_void_p]
+        lib.ba3c_env_size.restype = ctypes.c_int
+        lib.ba3c_env_reset.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8)]
+        lib.ba3c_env_step.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.ba3c_obs_height.restype = ctypes.c_int
+        lib.ba3c_obs_width.restype = ctypes.c_int
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return os.path.isfile(_LIB_PATH) or _try_build()
+
+
+class CppBatchedEnv:
+    """N native envs stepped in one call. Obs are uint8 [N, 84, 84]."""
+
+    def __init__(self, name: str, n: int, seed: int = 0):
+        lib = _load()
+        self._lib = lib
+        self._handle = lib.ba3c_env_create(name.encode(), n, seed)
+        if not self._handle:
+            raise ValueError(f"unknown native env {name!r}")
+        self.n = n
+        self.h = lib.ba3c_obs_height()
+        self.w = lib.ba3c_obs_width()
+        self.num_actions = lib.ba3c_env_num_actions(self._handle)
+        self._obs = np.zeros((n, self.h, self.w), np.uint8)
+        self._rew = np.zeros(n, np.float32)
+        self._done = np.zeros(n, np.uint8)
+
+    def reset(self) -> np.ndarray:
+        self._lib.ba3c_env_reset(
+            self._handle,
+            self._obs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        return self._obs
+
+    def step(
+        self, actions: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """actions int32 [N] -> (obs [N,84,84] u8, rewards [N] f32, dones [N] u8).
+
+        Returned arrays are internal buffers reused every call — copy if kept.
+        """
+        actions = np.ascontiguousarray(actions, np.int32)
+        assert actions.shape == (self.n,)
+        self._lib.ba3c_env_step(
+            self._handle,
+            actions.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            self._obs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            self._rew.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._done.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        return self._obs, self._rew, self._done
+
+    def close(self):
+        if self._handle:
+            self._lib.ba3c_env_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def build_cpp_player(idx: int, name: str = "pong", frame_history: int = 4):
+    """Single native env as a history-stacked player (wire-compatible with
+    build_fake_player; used by SimulatorProcess/eval parity paths)."""
+    from distributed_ba3c_tpu.envs.base import RLEnvironment
+    from distributed_ba3c_tpu.envs.wrappers import HistoryFramePlayer
+
+    class _CppPlayer(RLEnvironment):
+        def __init__(self):
+            self.env = CppBatchedEnv(name, 1, seed=idx)
+            self.env.reset()
+            self.score = 0.0
+            super().__init__()
+
+        def current_state(self):
+            return self.env._obs[0].copy()
+
+        def get_action_space_size(self):
+            return self.env.num_actions
+
+        def action(self, act):
+            _, rew, done = self.env.step(np.array([act], np.int32))
+            r, over = float(rew[0]), bool(done[0])
+            self.score += r
+            if over:
+                self.finish_episode(self.score)
+                self.score = 0.0
+            return r, over
+
+        def restart_episode(self):
+            self.env.reset()
+            self.score = 0.0
+
+    return HistoryFramePlayer(_CppPlayer(), frame_history)
+
+
+class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc]
+    """One process, B native envs, lockstep-batched stepping, ZMQ transport.
+
+    Each env gets its own DEALER socket with identity ``<prefix>-<i>`` so the
+    ROUTER-side master multiplexes B clients from one process. Protocol per
+    env matches SimulatorProcess exactly (SURVEY.md §3.2): send
+    [ident, stacked_state, reward, isOver], await action. Frame-history
+    stacking happens here (numpy ring buffer), matching HistoryFramePlayer.
+    """
+
+    def __init__(
+        self,
+        idx: int,
+        pipe_c2s: str,
+        pipe_s2c: str,
+        game: str = "pong",
+        n_envs: int = 16,
+        frame_history: int = 4,
+        ident_prefix: Optional[str] = None,
+    ):
+        super().__init__(daemon=True, name=f"cpp-env-server-{idx}")
+        self.idx = idx
+        self.c2s = pipe_c2s
+        self.s2c = pipe_s2c
+        self.game = game
+        self.n_envs = n_envs
+        self.frame_history = frame_history
+        self.ident_prefix = ident_prefix or f"cppsim-{idx}"
+
+    def run(self) -> None:  # child process: no jax
+        import zmq
+
+        from distributed_ba3c_tpu.utils.serialize import dumps, loads
+
+        env = CppBatchedEnv(self.game, self.n_envs, seed=self.idx * 10_000)
+        obs = env.reset()
+        B, H, W = self.n_envs, env.h, env.w
+        stacks = np.zeros((B, H, W, self.frame_history), np.uint8)
+        stacks[..., -1] = obs
+        rewards = np.zeros(B, np.float32)
+        dones = np.zeros(B, bool)
+
+        ctx = zmq.Context()
+        push = ctx.socket(zmq.PUSH)
+        push.set_hwm(B + 4)
+        push.connect(self.c2s)
+        idents = [f"{self.ident_prefix}-{i}".encode() for i in range(B)]
+        dealers = []
+        for ident in idents:
+            s = ctx.socket(zmq.DEALER)
+            s.setsockopt(zmq.IDENTITY, ident)
+            s.connect(self.s2c)
+            dealers.append(s)
+
+        actions = np.zeros(B, np.int32)
+        try:
+            while True:
+                for i in range(B):
+                    push.send(
+                        dumps([idents[i], stacks[i], float(rewards[i]), bool(dones[i])])
+                    )
+                for i in range(B):
+                    actions[i] = loads(dealers[i].recv())
+                obs, rew, dn = env.step(actions)
+                rewards[:] = rew
+                dones[:] = dn.astype(bool)
+                # shift history; clear across episode boundaries
+                stacks[..., :-1] = stacks[..., 1:]
+                stacks[..., -1] = obs
+                if dones.any():
+                    stacks[dones] = 0
+                    stacks[dones, :, :, -1] = obs[dones]
+        except (KeyboardInterrupt, zmq.ContextTerminated):
+            pass
+        finally:
+            for s in dealers:
+                s.close(0)
+            push.close(0)
+            ctx.term()
