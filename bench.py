@@ -51,14 +51,15 @@ def bench_fused(n_envs: int = 1024, rollout_len: int = 20, iters: int = 20) -> d
     )
     state = step.put(state)
 
-    # warmup / compile
+    # warmup / compile; fetch a VALUE (block_until_ready alone does not
+    # drain the async queue through the tunneled-TPU PJRT client)
     state, metrics = step(state, cfg.entropy_beta)
-    jax.block_until_ready(metrics)
+    float(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, cfg.entropy_beta)
-    jax.block_until_ready(metrics)
+    float(metrics["loss"])  # full sync: last iter depends on all prior state
     dt = time.perf_counter() - t0
 
     env_steps = iters * n_envs * n_chips * rollout_len

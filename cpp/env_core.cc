@@ -61,6 +61,16 @@ struct StepOut {
   bool done = false;
 };
 
+// clamped-rect rasterizer shared by all games
+void DrawRect(uint8_t* obs, float cx, float cy, float hw, float hh, uint8_t v) {
+  int x0 = std::max(0, (int)std::floor((cx - hw) * kW));
+  int x1 = std::min(kW - 1, (int)std::ceil((cx + hw) * kW));
+  int y0 = std::max(0, (int)std::floor((cy - hh) * kH));
+  int y1 = std::min(kH - 1, (int)std::ceil((cy + hh) * kH));
+  for (int y = y0; y <= y1; ++y)
+    for (int x = x0; x <= x1; ++x) obs[y * kW + x] = v;
+}
+
 class Env {
  public:
   virtual ~Env() = default;
@@ -163,16 +173,6 @@ class PongEnv : public Env {
     return reward;
   }
 
-  static void DrawRect(uint8_t* obs, float cx, float cy, float hw, float hh,
-                       uint8_t v) {
-    int x0 = std::max(0, (int)std::floor((cx - hw) * kW));
-    int x1 = std::min(kW - 1, (int)std::ceil((cx + hw) * kW));
-    int y0 = std::max(0, (int)std::floor((cy - hh) * kH));
-    int y1 = std::min(kH - 1, (int)std::ceil((cy + hh) * kH));
-    for (int y = y0; y <= y1; ++y)
-      for (int x = x0; x <= x1; ++x) obs[y * kW + x] = v;
-  }
-
   std::mt19937_64 rng_;
   float bx_, by_, vx_, vy_, agent_y_, opp_y_;
   int agent_score_, opp_score_;
@@ -222,17 +222,8 @@ class BreakoutEnv : public Env {
           for (int x = x0; x <= x1; ++x) obs[y * kW + x] = 180;
       }
     }
-    // ball + paddle
-    auto draw = [&](float cx, float cy, float hw, float hh, uint8_t v) {
-      int x0 = std::max(0, (int)std::floor((cx - hw) * kW));
-      int x1 = std::min(kW - 1, (int)std::ceil((cx + hw) * kW));
-      int y0 = std::max(0, (int)std::floor((cy - hh) * kH));
-      int y1 = std::min(kH - 1, (int)std::ceil((cy + hh) * kH));
-      for (int y = y0; y <= y1; ++y)
-        for (int x = x0; x <= x1; ++x) obs[y * kW + x] = v;
-    };
-    draw(bx_, by_, B::kBallR, B::kBallR, 255);
-    draw(paddle_x_, B::kPaddleY, B::kPaddleW / 2, B::kPaddleH, 255);
+    DrawRect(obs, bx_, by_, B::kBallR, B::kBallR, 255);
+    DrawRect(obs, paddle_x_, B::kPaddleY, B::kPaddleW / 2, B::kPaddleH, 255);
   }
 
   int NumActions() const override { return brk::kNumActions; }
@@ -357,7 +348,8 @@ class BatchedEnv {
       work(0, n);
       return;
     }
-    int nt = std::min<int>(std::thread::hardware_concurrency(), 8);
+    int nt = std::min<int>(
+        std::max(1u, std::thread::hardware_concurrency()), 8);
     std::vector<std::thread> threads;
     int chunk = (n + nt - 1) / nt;
     for (int t = 0; t < nt; ++t) {

@@ -1,0 +1,24 @@
+"""The actor plane: simulator processes + master, ZMQ experience transport.
+
+Reference equivalent: ``src/tensorpack/RL/simulator.py`` +
+``predict/concurrency.py`` (SURVEY.md §2.3). The experience plane keeps the
+reference's shape — N OS processes streaming (state, reward, isOver) over ZMQ
+to one master thread — while action serving collapses into a single batched
+device call (predict/server.py).
+"""
+
+from distributed_ba3c_tpu.actors.simulator import (
+    ClientState,
+    SimulatorMaster,
+    SimulatorProcess,
+    TransitionExperience,
+)
+from distributed_ba3c_tpu.actors.master import BA3CSimulatorMaster
+
+__all__ = [
+    "ClientState",
+    "SimulatorMaster",
+    "SimulatorProcess",
+    "TransitionExperience",
+    "BA3CSimulatorMaster",
+]

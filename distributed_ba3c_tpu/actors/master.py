@@ -1,0 +1,79 @@
+"""BA3CSimulatorMaster: the master↔trainer bridge with n-step assembly.
+
+Reference equivalent: ``MySimulatorMaster`` in ``src/train.py`` (SURVEY.md
+§2.1 #3, call stack §3.2): on each state request a batched prediction, record
+the (state, action, value) transition, and on episode end or
+LOCAL_TIME_MAX-truncation fold the client's memory into discounted n-step
+returns pushed to the training queue.
+"""
+
+from __future__ import annotations
+
+import queue
+from typing import Optional
+
+import numpy as np
+
+from distributed_ba3c_tpu.actors.simulator import (
+    SimulatorMaster,
+    TransitionExperience,
+)
+from distributed_ba3c_tpu.predict.server import BatchedPredictor
+
+
+class BA3CSimulatorMaster(SimulatorMaster):
+    """Feeds the training queue with [state, action, n-step return] triples."""
+
+    def __init__(
+        self,
+        pipe_c2s: str,
+        pipe_s2c: str,
+        predictor: BatchedPredictor,
+        gamma: float = 0.99,
+        local_time_max: int = 5,
+        train_queue: Optional[queue.Queue] = None,
+        score_queue: Optional[queue.Queue] = None,
+    ):
+        super().__init__(pipe_c2s, pipe_s2c)
+        self.predictor = predictor
+        self.gamma = gamma
+        self.local_time_max = local_time_max
+        # bounded like the reference's FIFOQueue: backpressure pauses actors
+        self.queue: queue.Queue = train_queue or queue.Queue(maxsize=4096)
+        self.score_queue = score_queue
+
+    def _on_state(self, state: np.ndarray, ident: bytes) -> None:
+        def cb(action: int, value: float, logp: float):
+            client = self.clients[ident]
+            client.memory.append(TransitionExperience(state, action, value))
+            self.send_action(ident, action)
+
+        self.predictor.put_task(state, cb)
+
+    def _on_episode_over(self, ident: bytes) -> None:
+        client = self.clients[ident]
+        if self.score_queue is not None:
+            try:
+                self.score_queue.put_nowait(client.score)
+            except queue.Full:
+                pass
+        client.score = 0.0
+        self._parse_memory(0.0, ident, is_over=True)
+
+    def _on_datapoint(self, ident: bytes) -> None:
+        client = self.clients[ident]
+        if len(client.memory) == self.local_time_max + 1:
+            # bootstrap from the newest transition's value estimate
+            self._parse_memory(client.memory[-1].value, ident, is_over=False)
+
+    def _parse_memory(self, init_r: float, ident: bytes, is_over: bool) -> None:
+        client = self.clients[ident]
+        mem = client.memory
+        if not is_over:
+            last = mem[-1]
+            mem = mem[:-1]
+        R = float(init_r)
+        for k in reversed(mem):
+            R = k.reward + self.gamma * R
+            self.queue.put([k.state, k.action, np.float32(R)])
+        client.memory = [] if is_over else [last]

@@ -1,0 +1,260 @@
+"""Simulator processes and the master's receive loop (ZMQ experience plane).
+
+Reference equivalent: ``tensorpack/RL/simulator.py`` — ``SimulatorProcess``,
+``SimulatorMaster``, ``ClientState``, ``TransitionExperience`` (SURVEY.md §2.3
+#8-9, call stack §3.2). Wire protocol, kept byte-compatible in spirit:
+
+    sim -> master (PUSH -> PULL):  msgpack [ident, state u8-array, reward, isOver]
+    master -> sim (ROUTER -> DEALER ident-routed): msgpack action
+
+Both pipes default to ipc:// within a host; tcp:// works unchanged for
+remote actor hosts (the multi-host layout keeps actors host-side and only
+gradients on ICI — SURVEY.md §2.12).
+
+The child-process side imports no jax: children must stay lightweight (the
+reference ran ~50 per worker; we target hundreds per TPU host).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue
+import threading
+from abc import abstractmethod
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional
+
+import zmq
+
+from distributed_ba3c_tpu.envs.base import RLEnvironment
+from distributed_ba3c_tpu.utils import logger
+from distributed_ba3c_tpu.utils.serialize import dumps, loads
+
+
+class TransitionExperience:
+    """One (state, action, value) awaiting its reward attachment."""
+
+    __slots__ = ("state", "action", "reward", "value")
+
+    def __init__(self, state, action, value, reward=None):
+        self.state = state
+        self.action = action
+        self.value = value
+        self.reward = reward
+
+
+class ClientState:
+    """Per-simulator state held by the master, keyed by ZMQ ident."""
+
+    __slots__ = ("memory", "ident", "score", "last_seen")
+
+    def __init__(self, ident: bytes):
+        self.ident = ident
+        self.memory: List[TransitionExperience] = []
+        self.score = 0.0
+        self.last_seen = 0.0
+
+
+def default_pipes(name: str = "ba3c") -> tuple[str, str]:
+    """ipc:// pipe pair for one host (unique per pid so tests can nest)."""
+    base = f"ipc:///tmp/{name}-{os.getpid()}"
+    return f"{base}-c2s", f"{base}-s2c"
+
+
+_spawn_ctx = mp.get_context("spawn")
+
+
+class SimulatorProcess(_spawn_ctx.Process):  # type: ignore[name-defined]
+    """One OS process owning one player; loop: send state, await action, step.
+
+    Reference: ``SimulatorProcess._run`` (SURVEY.md §3.2). ``build_player``
+    must be picklable (a top-level function or functools.partial).
+
+    Spawned (not forked): the trainer process is multithreaded (JAX runtime,
+    predictor, master) and ``fork()`` from a threaded parent can deadlock the
+    child. Child processes import only numpy/zmq modules, never jax.
+    """
+
+    def __init__(
+        self,
+        idx: int,
+        pipe_c2s: str,
+        pipe_s2c: str,
+        build_player: Callable[[int], RLEnvironment],
+    ):
+        super().__init__(daemon=True, name=f"simulator-{idx}")
+        self.idx = idx
+        self.c2s = pipe_c2s
+        self.s2c = pipe_s2c
+        self._build_player = build_player
+
+    def run(self) -> None:
+        player = self._build_player(self.idx)
+        ident = f"simulator-{self.idx}".encode()
+        context = zmq.Context()
+        c2s = context.socket(zmq.PUSH)
+        c2s.setsockopt(zmq.IDENTITY, ident)
+        c2s.set_hwm(4)
+        c2s.connect(self.c2s)
+        s2c = context.socket(zmq.DEALER)
+        s2c.setsockopt(zmq.IDENTITY, ident)
+        s2c.connect(self.s2c)
+
+        state = player.current_state()
+        reward, is_over = 0.0, False
+        try:
+            while True:
+                c2s.send(dumps([ident, state, reward, is_over]))
+                action = loads(s2c.recv())
+                reward, is_over = player.action(action)
+                state = player.current_state()
+        except (KeyboardInterrupt, zmq.ContextTerminated):
+            pass
+        finally:
+            c2s.close(0)
+            s2c.close(0)
+            context.term()
+
+
+class SimulatorMaster(threading.Thread):
+    """Master thread: multiplexes all simulators, dispatches subclass hooks.
+
+    Reference: ``SimulatorMaster.run`` (SURVEY.md §3.2) — attach the incoming
+    reward to the previous transition, fire ``_on_episode_over`` /
+    ``_on_datapoint``, then ``_on_state`` for the fresh state. A dedicated
+    send thread drains ``send_queue`` so predictor callbacks never block on
+    the socket.
+    """
+
+    def __init__(
+        self,
+        pipe_c2s: str,
+        pipe_s2c: str,
+        actor_timeout: Optional[float] = None,
+    ):
+        """``actor_timeout``: seconds of silence after which a client's state
+        is dropped (failure detection the reference lacked, SURVEY.md §5 —
+        a dead simulator would otherwise pin its half-built rollout forever).
+        None disables pruning."""
+        super().__init__(daemon=True, name="SimulatorMaster")
+        self.actor_timeout = actor_timeout
+        self._last_prune = 0.0
+        self.context = zmq.Context()
+        self.c2s_socket = self.context.socket(zmq.PULL)
+        self.c2s_socket.bind(pipe_c2s)
+        self.c2s_socket.set_hwm(32)
+        self.s2c_socket = self.context.socket(zmq.ROUTER)
+        self.s2c_socket.bind(pipe_s2c)
+        self.s2c_socket.set_hwm(32)
+
+        self.clients: Dict[bytes, ClientState] = defaultdict(
+            lambda: ClientState(b"")
+        )
+        self.send_queue: "queue.Queue[list]" = queue.Queue(maxsize=1024)
+        self._stop_evt = threading.Event()
+
+        def send_loop():
+            while not self._stop_evt.is_set():
+                try:
+                    msg = self.send_queue.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                self.s2c_socket.send_multipart(msg)
+
+        self.send_thread = threading.Thread(
+            target=send_loop, daemon=True, name="SimulatorMaster-send"
+        )
+        self.send_thread.start()
+
+    def run(self) -> None:
+        poller = zmq.Poller()
+        poller.register(self.c2s_socket, zmq.POLLIN)
+        import time as _time
+
+        try:
+            while not self._stop_evt.is_set():
+                if not poller.poll(timeout=200):
+                    self._prune_dead_actors()
+                    continue
+                ident, state, reward, is_over = loads(self.c2s_socket.recv())
+                client = self.clients[ident]
+                client.ident = ident
+                client.last_seen = _time.time()
+                self._on_message(ident, state, reward, is_over)
+        except zmq.ContextTerminated:
+            logger.info("SimulatorMaster context terminated")
+
+    def _prune_dead_actors(self) -> None:
+        """Drop state of clients silent for > actor_timeout (actor loss is
+        tolerated: its partial rollout is discarded, training continues)."""
+        if self.actor_timeout is None:
+            return
+        import time as _time
+
+        now = _time.time()
+        if now - self._last_prune < self.actor_timeout / 4:
+            return
+        self._last_prune = now
+        dead = [
+            ident
+            for ident, c in self.clients.items()
+            if c.last_seen and now - c.last_seen > self.actor_timeout
+        ]
+        for ident in dead:
+            del self.clients[ident]
+            logger.warn(
+                "actor %s silent for >%.0fs — dropped its client state",
+                ident,
+                self.actor_timeout,
+            )
+
+    def _on_message(self, ident: bytes, state, reward: float, is_over: bool) -> None:
+        """Handle one simulator message (overridable; runs in master thread).
+
+        Default semantics: attach the reward to the previous transition, fire
+        the episode/datapoint hooks, then request an action for the new state.
+        Per-client ordering is serialized by the protocol — the simulator
+        blocks on its action, so no second message from ``ident`` can arrive
+        before ``_on_state``'s callback has run.
+        """
+        client = self.clients[ident]
+        if len(client.memory) > 0:
+            client.memory[-1].reward = reward
+            client.score += reward
+            if is_over:
+                self._on_episode_over(ident)
+            else:
+                self._on_datapoint(ident)
+        self._on_state(state, ident)
+
+    def send_action(self, ident: bytes, action: int) -> None:
+        self.send_queue.put([ident, dumps(int(action))])
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+
+    def close(self) -> None:
+        """Stop threads and tear down ZMQ without lingering sends."""
+        self._stop_evt.set()
+        self.send_thread.join(timeout=2)
+        self.context.destroy(linger=0)
+
+    @abstractmethod
+    def _on_state(self, state, ident: bytes) -> None:
+        """A fresh state arrived: request an action and record the transition."""
+
+    @abstractmethod
+    def _on_episode_over(self, ident: bytes) -> None:
+        """The client's episode ended (reward already attached)."""
+
+    @abstractmethod
+    def _on_datapoint(self, ident: bytes) -> None:
+        """A mid-episode transition completed (reward already attached)."""
+
+    def __del__(self):
+        try:
+            self._stop_evt.set()
+            self.context.destroy(0)
+        except Exception:
+            pass

@@ -1,0 +1,119 @@
+"""VTraceSimulatorMaster: fixed-length rollout segments with behavior log-probs.
+
+The V-trace learner (parallel/vtrace_step.py) consumes IMPALA-style unrolls:
+segments of exactly ``unroll_len`` transitions that run straight across
+episode boundaries (``done`` flags mark them; the reverse scan zeroes the
+discount there). This differs from :class:`BA3CSimulatorMaster`'s
+per-episode n-step flush — static segment shapes are what keep the learner
+a single compiled program (no per-length recompiles).
+
+Reference context: no equivalent exists — the reference's async PS updates
+tolerate staleness silently (SURVEY.md §3.4); this is the principled TPU-side
+replacement (BASELINE.json config #4).
+"""
+
+from __future__ import annotations
+
+import queue
+from typing import Optional
+
+import numpy as np
+
+from distributed_ba3c_tpu.actors.simulator import SimulatorMaster
+from distributed_ba3c_tpu.predict.server import BatchedPredictor
+
+
+class _Step:
+    __slots__ = ("state", "action", "logp", "reward", "done")
+
+    def __init__(self, state, action, logp):
+        self.state = state
+        self.action = action
+        self.logp = logp
+        self.reward = 0.0
+        self.done = False
+
+
+class VTraceSimulatorMaster(SimulatorMaster):
+    """Emits segment dicts onto ``queue``:
+
+    ``{"state": [T,...], "action": [T], "reward": [T], "done": [T],
+       "behavior_log_probs": [T], "bootstrap_state": [...]}``
+    """
+
+    def __init__(
+        self,
+        pipe_c2s: str,
+        pipe_s2c: str,
+        predictor: BatchedPredictor,
+        unroll_len: int = 5,
+        train_queue: Optional[queue.Queue] = None,
+        score_queue: Optional[queue.Queue] = None,
+    ):
+        super().__init__(pipe_c2s, pipe_s2c)
+        self.predictor = predictor
+        self.unroll_len = unroll_len
+        self.queue: queue.Queue = train_queue or queue.Queue(maxsize=1024)
+        self.score_queue = score_queue
+
+    def _on_state(self, state: np.ndarray, ident: bytes) -> None:
+        def cb(action: int, value: float, logp: float):
+            client = self.clients[ident]
+            client.memory.append(_Step(state, action, logp))
+            self.send_action(ident, action)
+
+        self.predictor.put_task(state, cb)
+
+    def _on_datapoint(self, ident: bytes) -> None:
+        pass  # segment emission happens in _on_message
+
+    def _on_episode_over(self, ident: bytes) -> None:
+        client = self.clients[ident]
+        if self.score_queue is not None:
+            try:
+                self.score_queue.put_nowait(client.score)
+            except queue.Full:
+                pass
+        client.score = 0.0
+
+    def _on_message(self, ident: bytes, state, reward: float, is_over: bool) -> None:
+        """Attach (reward, done) to the newest transition, emit full unrolls,
+        then request the next action.
+
+        Runs ONLY in the master thread, and the emission check happens before
+        ``_on_state`` queues the next predict task — so no predictor-thread
+        append can race the ``client.memory`` reslice (the simulator is
+        blocked on its action until the callback runs).
+        """
+        client = self.clients[ident]
+        if len(client.memory) > 0:
+            step = client.memory[-1]
+            step.reward = reward
+            step.done = is_over
+            client.score += reward
+            if is_over:
+                self._on_episode_over(ident)
+            self._maybe_emit(ident)
+        self._on_state(state, ident)
+
+    def _maybe_emit(self, ident: bytes) -> None:
+        """When T+1 completed transitions exist, emit the first T.
+
+        The (T+1)-th transition's state is the bootstrap state AND the first
+        transition of the next segment — unrolls tile time with no gaps.
+        """
+        client = self.clients[ident]
+        T = self.unroll_len
+        if len(client.memory) < T + 1:
+            return
+        seg, rest = client.memory[:T], client.memory[T:]
+        segment = {
+            "state": np.stack([s.state for s in seg]),
+            "action": np.asarray([s.action for s in seg], np.int32),
+            "reward": np.asarray([s.reward for s in seg], np.float32),
+            "done": np.asarray([s.done for s in seg], np.float32),
+            "behavior_log_probs": np.asarray([s.logp for s in seg], np.float32),
+            "bootstrap_state": rest[0].state,
+        }
+        client.memory = rest
+        self.queue.put(segment)

@@ -70,8 +70,20 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh_data", type=int, default=None, help="data-axis size (defaults to all devices)")
     p.add_argument("--publish_every", type=int, default=1)
     p.add_argument("--rollout_len", type=int, default=20, help="fused-trainer rollout length per update")
+    p.add_argument("--actor_timeout", type=float, default=120.0, help="seconds of actor silence before its state is dropped (0=off)")
     p.add_argument("--profiler_port", type=int, default=0, help="start jax.profiler server on this port (0=off)")
     return p
+
+
+def env_num_actions(args) -> int:
+    """Derive the action-space size from the selected env (every trainer must
+    build the policy head against the ENV's space, not the flag default)."""
+    if args.env.startswith(("jax:", "cpp:")):
+        # jaxenv and the C++ core keep identical action maps (tested parity)
+        from distributed_ba3c_tpu.envs import jaxenv
+
+        return jaxenv.get_env(args.env.split(":", 1)[1]).num_actions
+    return args.num_actions
 
 
 def build_config(args) -> BA3CConfig:
@@ -87,7 +99,7 @@ def build_config(args) -> BA3CConfig:
             over[f] = v
     if args.image_size is not None:
         over["image_size"] = (args.image_size, args.image_size)
-    over["num_actions"] = args.num_actions
+    over["num_actions"] = env_num_actions(args)
     return cfg.replace(**over)
 
 
@@ -176,13 +188,14 @@ def main(argv: Optional[list] = None) -> int:
         jax.profiler.start_server(args.profiler_port)
         logger.info("jax profiler server on :%d", args.profiler_port)
 
+    if args.task in ("eval", "play"):
+        state = create_train_state(jax.random.PRNGKey(0), model, cfg, optimizer)
+        return _run_eval(args, cfg, model, state)
+
     if args.trainer == "tpu_fused_ba3c":
         return _run_fused(args, cfg, model, optimizer)
 
     state = create_train_state(jax.random.PRNGKey(0), model, cfg, optimizer)
-
-    if args.task in ("eval", "play"):
-        return _run_eval(args, cfg, model, state)
 
     mesh = make_mesh(num_data=args.mesh_data, num_model=1)
 
@@ -242,6 +255,7 @@ def main(argv: Optional[list] = None) -> int:
         )
         feed = TrainFeed(master.queue, cfg.batch_size)
         samples_per_step = cfg.batch_size
+    master.actor_timeout = args.actor_timeout or None
     if args.env.startswith("cpp:"):
         # batched native servers: each process hosts up to 16 envs in lockstep
         from distributed_ba3c_tpu.envs import native
@@ -278,6 +292,9 @@ def main(argv: Optional[list] = None) -> int:
         ModelSaver(),
         MaxSaver(),
     ]
+    from distributed_ba3c_tpu.train.experiment import ExperimentLogger
+
+    callbacks.append(ExperimentLogger())
     trainer = Trainer(
         TrainLoopConfig(
             steps_per_epoch=args.steps_per_epoch,

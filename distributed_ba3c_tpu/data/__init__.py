@@ -1,0 +1,18 @@
+"""Data plane: datapoint flows and the queue→batch bridge to the learner.
+
+Reference equivalent: ``tensorpack/dataflow/`` + ``QueueInput`` (SURVEY.md
+§2.4 #11-12). The reference's generator-of-datapoints + TF FIFOQueue pipeline
+becomes: a bounded host queue filled by the master, a batcher thread stacking
+uint8 datapoints, and (in the trainer) async device_put against the mesh
+sharding so H2D overlaps compute.
+"""
+
+from distributed_ba3c_tpu.data.dataflow import (
+    BatchData,
+    DataFlow,
+    QueueDataFlow,
+    RolloutFeed,
+    TrainFeed,
+)
+
+__all__ = ["BatchData", "DataFlow", "QueueDataFlow", "RolloutFeed", "TrainFeed"]

@@ -1,0 +1,146 @@
+"""DataFlow abstraction + the train-queue batcher.
+
+Reference equivalents (SURVEY.md §2.4): ``DataFlow.get_data`` generator
+protocol, ``BatchData`` (stacks datapoints), ``QueueInput``/``EnqueueThread``
+(bridges a flow into the trainer's queue). ``PrefetchDataZMQ`` is not
+reproduced as-is: its job (move batching off the hot thread) is done by
+``TrainFeed``'s dedicated batcher thread; cross-process prefetch is already
+what the simulator plane does.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from abc import ABC, abstractmethod
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+class DataFlow(ABC):
+    """A restartable stream of datapoints (lists of numpy-compatible items)."""
+
+    @abstractmethod
+    def get_data(self) -> Iterator[list]:
+        ...
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+
+class QueueDataFlow(DataFlow):
+    """Yields datapoints pulled from a (thread-safe) queue, forever."""
+
+    def __init__(self, q: "queue.Queue[list]"):
+        self.q = q
+
+    def get_data(self) -> Iterator[list]:
+        while True:
+            yield self.q.get()
+
+
+class BatchData(DataFlow):
+    """Stack ``batch_size`` consecutive datapoints along a new leading axis."""
+
+    def __init__(self, ds: DataFlow, batch_size: int):
+        self.ds = ds
+        self.batch_size = batch_size
+
+    def get_data(self) -> Iterator[List[np.ndarray]]:
+        it = self.ds.get_data()
+        while True:
+            holder = [next(it) for _ in range(self.batch_size)]
+            yield [
+                np.stack([dp[i] for dp in holder])
+                for i in range(len(holder[0]))
+            ]
+
+
+class _BatchFeed:
+    """Batcher thread base: item queue → ready stacked batches.
+
+    The learner calls :meth:`next_batch`; a dedicated thread keeps up to
+    ``prefetch`` collated batches ready so batch assembly overlaps the device
+    step (the reference used an EnqueueThread + TF FIFOQueue for the same
+    overlap). Subclasses define :meth:`_collate`.
+    """
+
+    def __init__(
+        self,
+        in_queue: "queue.Queue",
+        batch_size: int,
+        prefetch: int = 2,
+    ):
+        self.in_queue = in_queue
+        self.batch_size = batch_size
+        self._out: "queue.Queue[Dict[str, np.ndarray]]" = queue.Queue(
+            maxsize=prefetch
+        )
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=type(self).__name__
+        )
+
+    def _collate(self, holder: List) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        holder: List = []
+        while not self._stop.is_set():
+            try:
+                holder.append(self.in_queue.get(timeout=0.2))
+            except queue.Empty:
+                continue
+            if len(holder) < self.batch_size:
+                continue
+            batch = self._collate(holder)
+            holder = []
+            while not self._stop.is_set():
+                try:
+                    self._out.put(batch, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+
+    def next_batch(self, timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+        return self._out.get(timeout=timeout)
+
+    def qsize(self) -> int:
+        return self._out.qsize()
+
+
+class TrainFeed(_BatchFeed):
+    """[state, action, R] datapoints → flat {state, action, return} batches."""
+
+    def _collate(self, holder: List[list]) -> Dict[str, np.ndarray]:
+        return {
+            "state": np.stack([dp[0] for dp in holder]),
+            "action": np.asarray([dp[1] for dp in holder], np.int32),
+            "return": np.asarray([dp[2] for dp in holder], np.float32),
+        }
+
+
+class RolloutFeed(_BatchFeed):
+    """V-trace segment dicts → time-major [T, B] batches.
+
+    Stacks ``batch_size`` segments from ``VTraceSimulatorMaster`` along a new
+    batch axis and transposes time to the front (the reverse-scan layout of
+    ops/vtrace.py).
+    """
+
+    def _collate(self, holder: List[dict]) -> Dict[str, np.ndarray]:
+        batch = {}
+        for k in ("state", "action", "reward", "done", "behavior_log_probs"):
+            stacked = np.stack([seg[k] for seg in holder], axis=0)  # [B,T,...]
+            batch[k] = np.swapaxes(stacked, 0, 1).copy()  # [T,B,...]
+        batch["bootstrap_state"] = np.stack(
+            [seg["bootstrap_state"] for seg in holder]
+        )
+        return batch

@@ -1,0 +1,26 @@
+"""Environments and the player protocol.
+
+Reference equivalent: ``src/tensorpack/RL/`` + ``src/atari.py`` (SURVEY.md
+§2.2). The player protocol (``current_state`` / ``action`` / ``reset_stat``)
+is kept so simulator processes, eval, and wrappers compose identically; the
+on-device vectorized envs (``envs/jax/``) are the TPU-native addition.
+"""
+
+from distributed_ba3c_tpu.envs.base import RLEnvironment, ProxyPlayer
+from distributed_ba3c_tpu.envs.fake import FakeEnv
+from distributed_ba3c_tpu.envs.wrappers import (
+    HistoryFramePlayer,
+    LimitLengthPlayer,
+    MapPlayerState,
+    PreventStuckPlayer,
+)
+
+__all__ = [
+    "RLEnvironment",
+    "ProxyPlayer",
+    "FakeEnv",
+    "HistoryFramePlayer",
+    "LimitLengthPlayer",
+    "MapPlayerState",
+    "PreventStuckPlayer",
+]

@@ -1,0 +1,83 @@
+"""The player protocol.
+
+Reference equivalent: ``tensorpack/RL/envbase.py`` — ``RLEnvironment`` with
+``current_state() / action(a) -> (reward, isOver) / reset_stat()`` and
+``ProxyPlayer`` (SURVEY.md §1 L2 interface, §2.2 #6). Episodes auto-restart:
+after ``action`` returns ``isOver=True`` the player's ``current_state()`` is
+the first observation of a fresh episode — simulator loops never call reset.
+
+Deliberately numpy-only (no jax import): this module runs inside simulator
+child processes.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Tuple
+
+import numpy as np
+
+
+class RLEnvironment(ABC):
+    """A single sequential environment ("player")."""
+
+    def __init__(self):
+        self.reset_stat()
+
+    @abstractmethod
+    def current_state(self) -> np.ndarray:
+        """Observation for the current timestep."""
+
+    @abstractmethod
+    def action(self, act: int) -> Tuple[float, bool]:
+        """Take an action. Returns (reward, isOver); restarts on episode end."""
+
+    def reset_stat(self) -> None:
+        """Reset accumulated per-episode statistics."""
+        self.stats = {"score": []}
+
+    def finish_episode(self, score: float) -> None:
+        self.stats["score"].append(score)
+
+    def get_action_space_size(self) -> int:
+        raise NotImplementedError
+
+    def restart_episode(self) -> None:
+        """Force-restart the current episode (used by eval)."""
+        raise NotImplementedError
+
+
+class ProxyPlayer(RLEnvironment):
+    """Base for wrappers: forwards everything to the wrapped player."""
+
+    def __init__(self, player: RLEnvironment):
+        self.player = player
+        super().__init__()
+
+    def current_state(self):
+        return self.player.current_state()
+
+    def action(self, act):
+        return self.player.action(act)
+
+    def reset_stat(self):
+        # Called from __init__ before self.player may exist on subclasses that
+        # set attributes first; ProxyPlayer.__init__ assigns player beforehand.
+        self.player.reset_stat()
+
+    @property
+    def stats(self):
+        return self.player.stats
+
+    @stats.setter
+    def stats(self, v):  # RLEnvironment.__init__ compatibility
+        pass
+
+    def finish_episode(self, score):
+        self.player.finish_episode(score)
+
+    def get_action_space_size(self):
+        return self.player.get_action_space_size()
+
+    def restart_episode(self):
+        self.player.restart_episode()

@@ -1,0 +1,118 @@
+"""FakeEnv: a scripted chain MDP with a known optimal return.
+
+The e2e smoke harness the reference never had (SURVEY.md §4, §7 step 3): the
+whole actor plane (ZMQ, master, predictor, learner) is exercised against this
+env with zero Atari dependency, and "does it learn" becomes an assertion
+against a known optimum instead of an overnight learning curve.
+
+MDP: positions 0..chain_len-1, start at 0. Action 1 moves right, action 0
+moves left, all other actions are no-ops. Reaching the right end pays +1 and
+ends the episode; episodes also end after ``max_steps``. Optimal policy
+(always right) scores 1.0 per episode in chain_len-1 steps.
+
+Observation: image_size grayscale uint8 frame; the agent's position is drawn
+as a bright vertical bar (position maps to horizontal placement), so a conv
+policy can read it. numpy-only — runs in simulator child processes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from distributed_ba3c_tpu.envs.base import RLEnvironment
+
+
+class FakeEnv(RLEnvironment):
+    def __init__(
+        self,
+        chain_len: int = 4,
+        max_steps: int = 16,
+        image_size: Tuple[int, int] = (84, 84),
+        num_actions: int = 4,
+        noise: int = 10,
+        seed: int = 0,
+    ):
+        self.chain_len = chain_len
+        self.max_steps = max_steps
+        self.image_size = image_size
+        self.num_actions = num_actions
+        self.noise = noise
+        self._rng = np.random.default_rng(seed)
+        super().__init__()
+        self._restart()
+
+    def _restart(self):
+        self.pos = 0
+        self.steps = 0
+        self.score = 0.0
+
+    def _render(self) -> np.ndarray:
+        h, w = self.image_size
+        frame = self._rng.integers(
+            0, self.noise + 1, (h, w), dtype=np.uint8
+        ) if self.noise else np.zeros((h, w), np.uint8)
+        # bright bar at the column band for the current position
+        band = w // self.chain_len
+        lo = self.pos * band
+        frame[:, lo : lo + band] = 230
+        return frame
+
+    def current_state(self) -> np.ndarray:
+        return self._render()
+
+    def get_action_space_size(self) -> int:
+        return self.num_actions
+
+    def action(self, act: int) -> Tuple[float, bool]:
+        if act == 1:
+            self.pos = min(self.pos + 1, self.chain_len - 1)
+        elif act == 0:
+            self.pos = max(self.pos - 1, 0)
+        self.steps += 1
+
+        reward = 0.0
+        is_over = False
+        if self.pos == self.chain_len - 1:
+            reward = 1.0
+            is_over = True
+        elif self.steps >= self.max_steps:
+            is_over = True
+
+        self.score += reward
+        if is_over:
+            self.finish_episode(self.score)
+            self._restart()
+        return reward, is_over
+
+    def restart_episode(self) -> None:
+        self._restart()
+
+    @property
+    def optimal_score(self) -> float:
+        return 1.0
+
+
+def build_fake_player(
+    idx: int,
+    image_size: Tuple[int, int] = (84, 84),
+    frame_history: int = 4,
+    chain_len: int = 4,
+    max_steps: int = 16,
+    num_actions: int = 4,
+    noise: int = 10,
+):
+    """Standard player assembly for FakeEnv actors (reference: ``get_player``
+    in ``src/train.py`` — base env → state map → frame history)."""
+    from distributed_ba3c_tpu.envs.wrappers import HistoryFramePlayer
+
+    env = FakeEnv(
+        chain_len=chain_len,
+        max_steps=max_steps,
+        image_size=image_size,
+        num_actions=num_actions,
+        noise=noise,
+        seed=idx,
+    )
+    return HistoryFramePlayer(env, frame_history)

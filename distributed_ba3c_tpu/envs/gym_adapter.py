@@ -1,0 +1,55 @@
+"""Gymnasium adapter to the player protocol.
+
+Reference equivalent: ``tensorpack/RL/gymenv.py`` ``GymEnv`` (SURVEY.md §2.2
+#7) — wraps any gym env into the ``current_state/action/reset_stat`` player
+protocol so the simulator/eval plumbing works unchanged. ALE is not installed
+in this image; classic-control envs (and anything else gymnasium ships) work,
+with an optional ``state_map`` to imageize observations for the conv net.
+numpy-only at import (gymnasium imported lazily) — safe in simulator children.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from distributed_ba3c_tpu.envs.base import RLEnvironment
+
+
+class GymEnv(RLEnvironment):
+    def __init__(
+        self,
+        name: str,
+        seed: int = 0,
+        state_map: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+    ):
+        import gymnasium
+
+        self.gymenv = gymnasium.make(name)
+        self._seed = seed
+        self.state_map = state_map or (lambda s: s)
+        self.score = 0.0
+        super().__init__()
+        self._obs, _ = self.gymenv.reset(seed=seed)
+
+    def current_state(self) -> np.ndarray:
+        return self.state_map(np.asarray(self._obs))
+
+    def get_action_space_size(self) -> int:
+        return int(self.gymenv.action_space.n)
+
+    def action(self, act: int) -> Tuple[float, bool]:
+        obs, r, terminated, truncated, _ = self.gymenv.step(act)
+        self._obs = obs
+        is_over = bool(terminated or truncated)
+        self.score += float(r)
+        if is_over:
+            self.finish_episode(self.score)
+            self.score = 0.0
+            self._obs, _ = self.gymenv.reset()
+        return float(r), is_over
+
+    def restart_episode(self) -> None:
+        self._obs, _ = self.gymenv.reset()
+        self.score = 0.0

@@ -1,0 +1,113 @@
+"""Player wrappers: frame-history stacking, state mapping, episode guards.
+
+Reference equivalents (SURVEY.md §2.2 #6): ``HistoryFramePlayer``
+(``RL/history.py``), ``MapPlayerState``, ``PreventStuckPlayer``,
+``LimitLengthPlayer`` (``RL/common.py``). numpy-only — runs in simulator
+child processes.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable
+
+import numpy as np
+
+from distributed_ba3c_tpu.envs.base import ProxyPlayer, RLEnvironment
+
+
+class HistoryFramePlayer(ProxyPlayer):
+    """Stack the last ``hist_len`` frames along the channel axis.
+
+    Output shape [H, W, hist_len * C]; the stack is zero-padded at episode
+    start and cleared across episode boundaries.
+    """
+
+    def __init__(self, player: RLEnvironment, hist_len: int):
+        super().__init__(player)
+        self.history: deque = deque(maxlen=hist_len)
+        self.history.append(self.player.current_state())
+
+    def current_state(self) -> np.ndarray:
+        assert len(self.history) != 0
+        diff_len = self.history.maxlen - len(self.history)
+        sample = self.history[0]
+        if sample.ndim == 2:
+            stack = [np.zeros_like(sample)] * diff_len + list(self.history)
+            return np.stack(stack, axis=-1)
+        stack = [np.zeros_like(sample)] * diff_len + list(self.history)
+        return np.concatenate(stack, axis=-1)
+
+    def action(self, act):
+        reward, is_over = self.player.action(act)
+        if is_over:
+            self.history.clear()
+        self.history.append(self.player.current_state())
+        return reward, is_over
+
+    def restart_episode(self):
+        super().restart_episode()
+        self.history.clear()
+        self.history.append(self.player.current_state())
+
+
+class MapPlayerState(ProxyPlayer):
+    """Apply ``func`` to every observation (e.g. resize / grayscale)."""
+
+    def __init__(self, player: RLEnvironment, func: Callable[[np.ndarray], np.ndarray]):
+        super().__init__(player)
+        self.func = func
+
+    def current_state(self):
+        return self.func(self.player.current_state())
+
+
+class PreventStuckPlayer(ProxyPlayer):
+    """Force ``action_on_stuck`` if the observation repeats ``limit`` times.
+
+    Anti-stuck guard for games that pause until "fire" is pressed.
+    """
+
+    def __init__(self, player: RLEnvironment, limit: int, action_on_stuck: int):
+        super().__init__(player)
+        self.last_obs: deque = deque(maxlen=limit)
+        self.action_on_stuck = action_on_stuck
+
+    def action(self, act):
+        self.last_obs.append(hash(self.player.current_state().tobytes()))
+        if (
+            len(self.last_obs) == self.last_obs.maxlen
+            and len(set(self.last_obs)) == 1
+        ):
+            act = self.action_on_stuck
+        reward, is_over = self.player.action(act)
+        if is_over:
+            self.last_obs.clear()
+        return reward, is_over
+
+    def restart_episode(self):
+        super().restart_episode()
+        self.last_obs.clear()
+
+
+class LimitLengthPlayer(ProxyPlayer):
+    """Cap episode length at ``limit`` steps (reference cap: 40000)."""
+
+    def __init__(self, player: RLEnvironment, limit: int):
+        super().__init__(player)
+        self.limit = limit
+        self.cnt = 0
+
+    def action(self, act):
+        reward, is_over = self.player.action(act)
+        self.cnt += 1
+        if self.cnt >= self.limit and not is_over:
+            is_over = True
+            self.player.restart_episode()
+        if is_over:
+            self.cnt = 0
+        return reward, is_over
+
+    def restart_episode(self):
+        super().restart_episode()
+        self.cnt = 0

@@ -45,6 +45,42 @@ def grad_summaries(grads) -> Dict[str, jax.Array]:
     return {"grad_norm": gnorm, "grad_max_abs": gmax}
 
 
+def inject_learning_rate(opt_state, learning_rate):
+    """Functionally set the LR of an opt-state built by :func:`make_optimizer`.
+
+    The runtime-mutable-hyperparam mechanism behind ``ScheduledHyperParamSetter``
+    (reference: ``callbacks/param.py``, SURVEY.md §2.7 #21): the trainer passes
+    the scheduled LR into the jitted step each call; inside, the
+    ``InjectHyperparamsState`` leaf is replaced before ``optimizer.update``.
+    No-op (statically) if the optimizer was not built with inject_hyperparams.
+    """
+    if learning_rate is None:
+        return opt_state
+
+    changed = False
+
+    def maybe(s):
+        # Duck-typed: installed optax returns InjectStatefulHyperparamsState,
+        # which is NOT a subclass of InjectHyperparamsState — match any state
+        # carrying a hyperparams dict instead of an exact class.
+        nonlocal changed
+        hp = getattr(s, "hyperparams", None)
+        if hp is not None and hasattr(s, "_replace") and "learning_rate" in hp:
+            hp = dict(hp)
+            hp["learning_rate"] = jnp.asarray(learning_rate, jnp.float32)
+            changed = True
+            return s._replace(hyperparams=hp)
+        return s
+
+    if isinstance(opt_state, tuple) and not hasattr(opt_state, "_fields"):
+        new = tuple(maybe(s) for s in opt_state)
+    else:
+        new = maybe(opt_state)
+    # return the ORIGINAL object when nothing matched so callers can detect
+    # (and warn about) an optimizer without an injectable LR leaf
+    return new if changed else opt_state
+
+
 def make_optimizer(
     learning_rate,
     adam_epsilon: float = 1e-3,

@@ -1,0 +1,32 @@
+"""Symbolic helper functions.
+
+Reference equivalent: ``tensorpack/tfutils/symbolic_functions.py`` (SURVEY.md
+§2.6 #18) — the grab-bag of loss/metric helpers the model code pulls from
+(huber loss, prediction error counts). Pure jnp functions here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def huber_loss(x: jax.Array, delta: float = 1.0) -> jax.Array:
+    """Elementwise Huber: quadratic within |x|<=delta, linear outside."""
+    abs_x = jnp.abs(x)
+    quad = 0.5 * jnp.square(x)
+    lin = delta * (abs_x - 0.5 * delta)
+    return jnp.where(abs_x <= delta, quad, lin)
+
+
+def prediction_incorrect(
+    logits: jax.Array, labels: jax.Array, topk: int = 1
+) -> jax.Array:
+    """1.0 where the label is NOT in the top-k predictions (error vector)."""
+    _, pred = jax.lax.top_k(logits, topk)
+    hit = jnp.any(pred == labels[:, None], axis=-1)
+    return (~hit).astype(jnp.float32)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array, topk: int = 1) -> jax.Array:
+    return 1.0 - jnp.mean(prediction_incorrect(logits, labels, topk))

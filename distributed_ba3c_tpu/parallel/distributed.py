@@ -1,0 +1,92 @@
+"""Multi-host bootstrap: DCN-coordinated mesh over pod slices.
+
+Reference equivalent (SURVEY.md §2.5 #15, §2.12): ``tf.train.ClusterSpec`` +
+``tf.train.Server`` + ``replica_device_setter`` — host:port lists wiring an
+async parameter-server gradient plane over gRPC. TPU-native replacement:
+``jax.distributed.initialize`` bootstraps all hosts over DCN, every host sees
+the global device set, and the SAME mesh/shard_map code compiles into
+programs whose collectives ride ICI within a slice and DCN across slices —
+no separate code path, no parameter servers.
+
+The reference's CLI surface maps directly:
+    --worker_hosts h1:p,h2:p --task_index k
+        -> initialize(coordinator=h1:p, num_processes=len(hosts), process_id=k)
+    --ps_hosts  -> obsolete (accepted, ignored; cli.py prints why)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from distributed_ba3c_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from distributed_ba3c_tpu.utils import logger
+
+
+def initialize_from_flags(
+    worker_hosts: str, task_index: int, coordinator_port: Optional[int] = None
+) -> bool:
+    """Bootstrap jax.distributed from reference-style flags.
+
+    ``worker_hosts`` is the comma-separated host:port list every worker gets
+    (identically ordered); ``task_index`` is this worker's rank. Returns True
+    if distributed mode was initialized, False for single-host (empty list or
+    a single entry).
+    """
+    hosts = [h for h in worker_hosts.split(",") if h]
+    if len(hosts) <= 1:
+        return False
+    coordinator = hosts[0]
+    if coordinator_port is not None:
+        coordinator = f"{coordinator.split(':')[0]}:{coordinator_port}"
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=len(hosts),
+        process_id=task_index,
+    )
+    logger.info(
+        "jax.distributed up: process %d/%d, %d global devices (%d local)",
+        task_index,
+        len(hosts),
+        len(jax.devices()),
+        len(jax.local_devices()),
+    )
+    return True
+
+
+def make_global_mesh(
+    num_model: int = 1, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Data-parallel mesh over ALL hosts' devices.
+
+    Device order groups each host's local devices contiguously, so the data
+    axis's psum segments ride ICI within a host/slice and only the cross-host
+    hop uses DCN (the axis is laid out host-major).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    devices.sort(key=lambda d: (d.process_index, d.id))
+    num_data = len(devices) // num_model
+    arr = np.asarray(devices).reshape(num_data, num_model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def is_chief() -> bool:
+    """Chief == process 0 (the reference's chief-worker saver/summary role)."""
+    return jax.process_index() == 0
+
+
+def local_batch_slice(global_batch: int) -> slice:
+    """The rows of a host-major global batch this process should feed.
+
+    Multi-host data loading contract: every host feeds its own actors and
+    device_puts only its slice of the global batch; jax assembles the global
+    sharded array from per-host shards.
+    """
+    n = jax.process_count()
+    assert global_batch % n == 0, (global_batch, n)
+    per = global_batch // n
+    k = jax.process_index()
+    return slice(k * per, (k + 1) * per)

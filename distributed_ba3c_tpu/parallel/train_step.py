@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_ba3c_tpu.config import BA3CConfig
 from distributed_ba3c_tpu.models.a3c import BA3CNet
-from distributed_ba3c_tpu.ops.gradproc import grad_summaries
+from distributed_ba3c_tpu.ops.gradproc import grad_summaries, inject_learning_rate
 from distributed_ba3c_tpu.ops.loss import a3c_loss
 from distributed_ba3c_tpu.parallel.mesh import DATA_AXIS
 
@@ -54,6 +54,14 @@ def create_train_state(
     dummy = jnp.zeros((1, *cfg.state_shape), jnp.uint8)
     params = model.init(rng, dummy)["params"]
     opt_state = optimizer.init(params)
+    if inject_learning_rate(opt_state, 0.0) is opt_state:
+        from distributed_ba3c_tpu.utils import logger
+
+        logger.warn(
+            "optimizer has no injectable learning_rate leaf — runtime LR "
+            "schedules (ScheduledHyperParamSetter etc.) will be SILENT no-ops;"
+            " build it with ops.gradproc.make_optimizer"
+        )
     return TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
 
 
@@ -64,6 +72,7 @@ def _local_step(
     state: TrainState,
     batch: Dict[str, jax.Array],
     entropy_beta: jax.Array,
+    learning_rate: jax.Array,
 ) -> Tuple[TrainState, Dict[str, jax.Array]]:
     """Per-device shard-local step body; runs inside shard_map."""
 
@@ -89,7 +98,8 @@ def _local_step(
     n_data = jax.lax.axis_size(DATA_AXIS)
     grads = jax.tree_util.tree_map(lambda g: g / n_data, grads)
 
-    updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+    opt_state = inject_learning_rate(state.opt_state, learning_rate)
+    updates, new_opt_state = optimizer.update(grads, opt_state, state.params)
     new_params = optax.apply_updates(state.params, updates)
     new_state = TrainState(
         step=state.step + 1, params=new_params, opt_state=new_opt_state
@@ -127,14 +137,21 @@ def make_train_step(
     sharded = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(replicated, batch_spec, replicated),
+        in_specs=(replicated, batch_spec, replicated, replicated),
         out_specs=(replicated, replicated),
     )
 
     jitted = jax.jit(sharded, donate_argnums=(0,))
 
-    def step(state, batch, entropy_beta):
-        return jitted(state, batch, jnp.asarray(entropy_beta, jnp.float32))
+    def step(state, batch, entropy_beta, learning_rate=None):
+        if learning_rate is None:
+            learning_rate = cfg.learning_rate
+        return jitted(
+            state,
+            batch,
+            jnp.asarray(entropy_beta, jnp.float32),
+            jnp.asarray(learning_rate, jnp.float32),
+        )
 
     # expose shardings so callers can device_put batches asynchronously
     step.batch_sharding = NamedSharding(mesh, batch_spec)

@@ -1,0 +1,11 @@
+"""Inference serving: the batched on-device action server.
+
+Reference equivalent: ``tensorpack/predict/{concurrency,common,base}.py`` —
+``MultiThreadAsyncPredictor`` et al. (SURVEY.md §2.3 #10, call stack §3.3).
+The N-thread, N-``Session.run`` design collapses into one jitted forward +
+on-device categorical sampling; host threads only batch and dispatch.
+"""
+
+from distributed_ba3c_tpu.predict.server import BatchedPredictor
+
+__all__ = ["BatchedPredictor"]

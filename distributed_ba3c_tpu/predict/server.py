@@ -1,0 +1,162 @@
+"""BatchedPredictor: micro-batching action server on one jitted device call.
+
+Reference equivalent (SURVEY.md §3.3): ``MultiThreadAsyncPredictor`` /
+``PredictorWorkerThread`` — N threads each draining a shared queue into a
+``sess.run`` on a predict tower. TPU-native redesign per BASELINE.json:
+
+- ONE compiled function: forward + categorical sample, executed on device;
+  action sampling never returns logits to the host (A ints instead of A
+  floats per sim cross the device boundary).
+- Batch shapes are bucketed to powers of two and padded, so XLA compiles a
+  handful of programs once instead of one per queue length.
+- Weights live in device HBM; the learner publishes fresh params with
+  ``update_params`` (an atomic Python ref swap — the reference's predict
+  towers read shared TF variables the same way).
+
+The worker thread dispatches callbacks; with the GIL this matches the
+reference's callback-from-worker-thread semantics.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_ba3c_tpu.utils.concurrency import StoppableThread
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length()
+
+
+class BatchedPredictor:
+    """Asynchronous batched (action, value) server.
+
+    Parameters
+    ----------
+    model: a flax module with ``apply({'params': p}, states) -> PolicyValue``.
+    params: initial parameter pytree (host or device).
+    batch_size: max micro-batch (reference PREDICT_BATCH_SIZE).
+    num_threads: worker threads draining the task queue (device calls
+        serialize on the device anyway; >1 only helps overlap host work).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        batch_size: int = 16,
+        num_threads: int = 1,
+        seed: int = 0,
+        greedy: bool = False,
+    ):
+        self._model = model
+        self._params = jax.device_put(params)
+        self._batch_size = batch_size
+        self._queue: "queue.Queue[Tuple[np.ndarray, Callable]]" = queue.Queue(
+            maxsize=4096
+        )
+        self._key = jax.random.PRNGKey(seed)
+        self._key_lock = threading.Lock()
+        self._greedy = greedy
+
+        def fwd_sample(params, states, key):
+            out = model.apply({"params": params}, states)
+            if greedy:
+                actions = jnp.argmax(out.logits, axis=-1)
+            else:
+                actions = jax.random.categorical(key, out.logits, axis=-1)
+            actions = actions.astype(jnp.int32)
+            # log mu(a|s): the behavior policy record V-trace needs
+            log_probs = jax.nn.log_softmax(out.logits, axis=-1)
+            logp = jnp.take_along_axis(log_probs, actions[:, None], axis=-1)[:, 0]
+            return actions, out.value, logp, out.logits
+
+        self._fwd = jax.jit(fwd_sample)
+        self.threads: List[StoppableThread] = [
+            StoppableThread(
+                target=self._worker, daemon=True, name=f"predictor-{i}"
+            )
+            for i in range(num_threads)
+        ]
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        for t in self.threads:
+            t.start()
+
+    def stop(self) -> None:
+        for t in self.threads:
+            t.stop()
+
+    # -- API ---------------------------------------------------------------
+    def update_params(self, params) -> None:
+        """Publish fresh weights (atomic ref swap; next batch uses them)."""
+        self._params = params
+
+    def put_task(
+        self, state: np.ndarray, callback: Callable[[int, float, float], None]
+    ) -> None:
+        """Queue one state; ``callback(action, value, logp)`` fires when
+        served — logp is log mu(action|state) under the sampling policy."""
+        self._queue.put((state, callback))
+
+    def predict_batch(
+        self, states: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Synchronous batched predict: (actions, values, logits) as numpy."""
+        actions, values, _, logits = self._run_device(np.asarray(states))
+        return actions, values, logits
+
+    # -- internals ---------------------------------------------------------
+    def _next_key(self):
+        with self._key_lock:
+            self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _run_device(self, batch: np.ndarray):
+        k = batch.shape[0]
+        padded = _next_pow2(max(k, 1))
+        if padded != k:
+            pad = np.zeros((padded - k, *batch.shape[1:]), batch.dtype)
+            batch = np.concatenate([batch, pad], axis=0)
+        actions, values, logps, logits = self._fwd(
+            self._params, batch, self._next_key()
+        )
+        return (
+            np.asarray(actions)[:k],
+            np.asarray(values)[:k],
+            np.asarray(logps)[:k],
+            np.asarray(logits)[:k],
+        )
+
+    def _fetch_batch(self, t: StoppableThread):
+        """Block for one task, then drain without waiting (reference
+        ``PredictorWorkerThread.fetch_batch`` semantics)."""
+        first = t.queue_get_stoppable(self._queue)
+        if first is None:
+            return None
+        tasks = [first]
+        while len(tasks) < self._batch_size:
+            try:
+                tasks.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        return tasks
+
+    def _worker(self) -> None:
+        t = threading.current_thread()
+        assert isinstance(t, StoppableThread)
+        while not t.stopped():
+            tasks = self._fetch_batch(t)
+            if tasks is None:
+                return
+            states = np.stack([s for s, _ in tasks])
+            actions, values, logps, _ = self._run_device(states)
+            for (_, cb), a, v, lp in zip(tasks, actions, values, logps):
+                cb(int(a), float(v), float(lp))

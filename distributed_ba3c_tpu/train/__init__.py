@@ -1,0 +1,40 @@
+"""Trainer, callbacks, checkpointing, eval.
+
+Reference equivalent: ``tensorpack/train/`` + ``tensorpack/callbacks/`` +
+``src/common.py`` (SURVEY.md §2.5, §2.7, §2.1 #4). The epochs×steps main loop
+and callback lifecycle survive; the gradient plane inside ``run_step`` is the
+mesh-sharded jitted update from :mod:`distributed_ba3c_tpu.parallel`.
+"""
+
+from distributed_ba3c_tpu.train.callbacks import (
+    Callback,
+    Callbacks,
+    Evaluator,
+    HumanHyperParamSetter,
+    HyperParamSetterWithFunc,
+    MaxSaver,
+    ModelSaver,
+    PeriodicTrigger,
+    ScheduledHyperParamSetter,
+    StartProcOrThread,
+    StatPrinter,
+)
+from distributed_ba3c_tpu.train.checkpoint import CheckpointManager
+from distributed_ba3c_tpu.train.trainer import Trainer, TrainLoopConfig
+
+__all__ = [
+    "Callback",
+    "Callbacks",
+    "Evaluator",
+    "HumanHyperParamSetter",
+    "HyperParamSetterWithFunc",
+    "MaxSaver",
+    "ModelSaver",
+    "PeriodicTrigger",
+    "ScheduledHyperParamSetter",
+    "StartProcOrThread",
+    "StatPrinter",
+    "CheckpointManager",
+    "Trainer",
+    "TrainLoopConfig",
+]

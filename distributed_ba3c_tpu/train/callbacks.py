@@ -1,0 +1,372 @@
+"""Callback system: lifecycle hooks around the training loop.
+
+Reference equivalents (SURVEY.md §2.7): ``Callback``/``Callbacks``/
+``PeriodicTrigger`` (``callbacks/{base,group}.py`` #19), ``ModelSaver``/
+``MaxSaver`` (``callbacks/common.py`` #20), ``ScheduledHyperParamSetter``/
+``HyperParamSetterWithFunc``/``HumanHyperParamSetter`` (``callbacks/param.py``
+#21), ``StatPrinter`` (``callbacks/stats.py`` #22), ``StartProcOrThread``
+(``callbacks/concurrency.py`` #23). Hook order in the loop matches §3.1:
+``before_train`` → per-step ``trigger_step`` → per-epoch ``trigger_epoch`` →
+``after_train``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from distributed_ba3c_tpu.utils import logger
+
+
+class Callback:
+    trainer = None  # set by setup()
+
+    def setup(self, trainer) -> None:
+        self.trainer = trainer
+
+    def before_train(self) -> None:
+        pass
+
+    def trigger_step(self, metrics: Optional[dict]) -> None:
+        pass
+
+    def trigger_epoch(self) -> None:
+        pass
+
+    def after_train(self) -> None:
+        pass
+
+
+class Callbacks(Callback):
+    """Dispatch group; after_train runs for every member even on errors."""
+
+    def __init__(self, cbs: Sequence[Callback]):
+        self.cbs = list(cbs)
+
+    def setup(self, trainer) -> None:
+        for cb in self.cbs:
+            cb.setup(trainer)
+
+    def before_train(self) -> None:
+        for cb in self.cbs:
+            cb.before_train()
+
+    def trigger_step(self, metrics) -> None:
+        for cb in self.cbs:
+            cb.trigger_step(metrics)
+
+    def trigger_epoch(self) -> None:
+        for cb in self.cbs:
+            cb.trigger_epoch()
+
+    def after_train(self) -> None:
+        for cb in self.cbs:
+            try:
+                cb.after_train()
+            except Exception:  # noqa: BLE001 - teardown must not cascade
+                import traceback
+
+                logger.error(
+                    "error in %s.after_train:\n%s",
+                    type(cb).__name__,
+                    traceback.format_exc(),
+                )
+
+
+class PeriodicTrigger(Callback):
+    """Run the wrapped callback's trigger_epoch every N epochs (or steps)."""
+
+    def __init__(
+        self,
+        cb: Callback,
+        every_k_epochs: Optional[int] = None,
+        every_k_steps: Optional[int] = None,
+    ):
+        assert (every_k_epochs is None) != (every_k_steps is None)
+        self.cb = cb
+        self.every_k_epochs = every_k_epochs
+        self.every_k_steps = every_k_steps
+
+    def setup(self, trainer):
+        super().setup(trainer)
+        self.cb.setup(trainer)
+
+    def before_train(self):
+        self.cb.before_train()
+
+    def trigger_step(self, metrics):
+        if (
+            self.every_k_steps
+            and self.trainer.global_step % self.every_k_steps == 0
+        ):
+            self.cb.trigger_epoch()
+
+    def trigger_epoch(self):
+        if (
+            self.every_k_epochs
+            and self.trainer.epoch_num % self.every_k_epochs == 0
+        ):
+            self.cb.trigger_epoch()
+
+    def after_train(self):
+        self.cb.after_train()
+
+
+class StartProcOrThread(Callback):
+    """Start simulator processes / master / predictor threads with the trainer.
+
+    Anything with ``.start()`` works; multiprocessing children are started
+    with SIGINT masked and registered for termination at exit.
+    """
+
+    def __init__(self, startables: Sequence) -> None:
+        self.startables = list(startables)
+
+    def before_train(self) -> None:
+        import multiprocessing as mp
+
+        from distributed_ba3c_tpu.utils.concurrency import (
+            ensure_proc_terminate,
+            start_proc_mask_signal,
+        )
+
+        procs = [s for s in self.startables if isinstance(s, mp.process.BaseProcess)]
+        others = [s for s in self.startables if not isinstance(s, mp.process.BaseProcess)]
+        if procs:
+            ensure_proc_terminate(procs)
+            start_proc_mask_signal(procs)
+        for s in others:
+            s.start()
+        logger.info(
+            "StartProcOrThread: started %d processes, %d threads/servers",
+            len(procs),
+            len(others),
+        )
+
+    def after_train(self) -> None:
+        for s in self.startables:
+            stop = getattr(s, "stop", None)
+            if callable(stop):
+                stop()
+            elif hasattr(s, "terminate"):
+                s.terminate()
+
+
+class HyperParamSetter(Callback):
+    """Base: sets ``trainer.hyperparams[name]`` at epoch boundaries."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def _value_to_set(self) -> Optional[float]:
+        raise NotImplementedError
+
+    def _set(self):
+        v = self._value_to_set()
+        if v is not None and v != self.trainer.hyperparams.get(self.name):
+            logger.info("hyperparam %s <- %.6g", self.name, v)
+            self.trainer.hyperparams[self.name] = v
+
+    def before_train(self):
+        self._set()
+
+    def trigger_epoch(self):
+        self._set()
+
+
+class ScheduledHyperParamSetter(HyperParamSetter):
+    """Piecewise schedule [(epoch, value), ...]; optional linear interp."""
+
+    def __init__(
+        self,
+        name: str,
+        schedule: Sequence[Tuple[int, float]],
+        interp: Optional[str] = None,
+    ):
+        super().__init__(name)
+        self.schedule = sorted(schedule)
+        assert interp in (None, "linear")
+        self.interp = interp
+
+    def _value_to_set(self) -> Optional[float]:
+        e = self.trainer.epoch_num
+        laste, lastv = None, None
+        for se, sv in self.schedule:
+            if se == e:
+                return sv
+            if se > e:
+                if self.interp is None or laste is None:
+                    return lastv
+                frac = (e - laste) / (se - laste)
+                return lastv + frac * (sv - lastv)
+            laste, lastv = se, sv
+        return lastv
+
+
+class HyperParamSetterWithFunc(HyperParamSetter):
+    """``func(epoch, current_value) -> value``."""
+
+    def __init__(self, name: str, func: Callable[[int, Optional[float]], float]):
+        super().__init__(name)
+        self.func = func
+
+    def _value_to_set(self):
+        return self.func(
+            self.trainer.epoch_num, self.trainer.hyperparams.get(self.name)
+        )
+
+
+class HumanHyperParamSetter(HyperParamSetter):
+    """Read ``<logdir>/<fname>`` lines of ``name: value`` each epoch.
+
+    The reference's human-editable live hyperparam file (SURVEY.md §2.7 #21).
+    """
+
+    def __init__(self, name: str, fname: str = "hyper.txt"):
+        super().__init__(name)
+        self.fname = fname
+
+    def _value_to_set(self) -> Optional[float]:
+        log_dir = self.trainer.config.log_dir
+        if log_dir is None:
+            return None
+        path = os.path.join(log_dir, self.fname)
+        if not os.path.isfile(path):
+            return None
+        try:
+            with open(path) as f:
+                dic = {
+                    k.strip(): float(v)
+                    for k, v in (line.split(":") for line in f if ":" in line)
+                }
+            return dic.get(self.name)
+        except (ValueError, OSError):
+            logger.warn("could not parse %s", path)
+            return None
+
+
+class StatPrinter(Callback):
+    """Samples step metrics, accumulates epoch stats, prints + stat.json.
+
+    Metric names follow the reference's summary plane (SURVEY.md §5):
+    loss/policy_loss/value_loss/entropy/grad_norm, mean_score/max_score, fps.
+    Device scalars are only fetched every ``sample_every`` steps so the hot
+    loop stays async.
+    """
+
+    def __init__(self, sample_every: int = 20):
+        self.sample_every = sample_every
+        self._counters: Dict[str, list] = {}
+        self._epoch_t0 = None
+        self._epoch_steps = 0
+
+    def before_train(self):
+        self._epoch_t0 = time.time()
+
+    def trigger_step(self, metrics):
+        self._epoch_steps += 1
+        if metrics is None or self.trainer.global_step % self.sample_every:
+            return
+        fetched = {k: float(v) for k, v in metrics.items()}
+        for k, v in fetched.items():
+            self._counters.setdefault(k, []).append(v)
+
+    def trigger_epoch(self):
+        tr = self.trainer
+        holder = tr.stat_holder
+        dt = time.time() - self._epoch_t0 if self._epoch_t0 else 0.0
+        samples = self._epoch_steps * tr.batch_size
+        fps = samples / dt if dt > 0 else 0.0
+        holder.add_stat("global_step", tr.global_step)
+        holder.add_stat("epoch", tr.epoch_num)
+        holder.add_stat("fps", fps)
+        for k, vs in self._counters.items():
+            if vs:
+                holder.add_stat(k, float(np.mean(vs)))
+        if tr.score_counter is not None and tr.score_counter.count:
+            holder.add_stat("mean_score", tr.score_counter.average)
+            holder.add_stat("max_score", tr.score_counter.max)
+            tr.last_mean_score = tr.score_counter.average
+            tr.score_counter.reset()
+        record = holder.finalize()
+        logger.info(
+            "epoch %d | step %d | fps %.0f | %s",
+            tr.epoch_num,
+            tr.global_step,
+            fps,
+            " ".join(
+                f"{k}={v:.4g}"
+                for k, v in record.items()
+                if k not in ("epoch", "global_step", "fps")
+            ),
+        )
+        self._counters = {}
+        self._epoch_steps = 0
+        self._epoch_t0 = time.time()
+
+
+class ModelSaver(Callback):
+    """Save the TrainState every epoch (chief only in multi-host)."""
+
+    def __init__(self, ckpt_dir: Optional[str] = None):
+        self.ckpt_dir = ckpt_dir
+
+    def before_train(self):
+        from distributed_ba3c_tpu.train.checkpoint import CheckpointManager
+
+        d = self.ckpt_dir or os.path.join(
+            self.trainer.config.log_dir or ".", "checkpoints"
+        )
+        if self.trainer.is_chief:
+            self.trainer.ckpt_manager = CheckpointManager(d)
+
+    def trigger_epoch(self):
+        if self.trainer.ckpt_manager is not None:
+            path = self.trainer.ckpt_manager.save(
+                self.trainer.state, self.trainer.global_step
+            )
+            logger.info("saved checkpoint %s", path)
+
+
+class MaxSaver(Callback):
+    """Mark the checkpoint as best when the monitored stat improves."""
+
+    def __init__(self, monitor: str = "mean_score"):
+        self.monitor = monitor
+
+    def trigger_epoch(self):
+        tr = self.trainer
+        if tr.ckpt_manager is None:
+            return
+        score = tr.last_mean_score
+        if score is not None and tr.ckpt_manager.mark_best(
+            tr.global_step, score
+        ):
+            logger.info("new best %s=%.3f", self.monitor, score)
+
+
+class Evaluator(Callback):
+    """Play eval episodes with the current (greedy) policy each epoch.
+
+    Reference: ``Evaluator`` in ``src/common.py`` (SURVEY.md §2.1 #4, §3.5).
+    Players run in lockstep so every forward is one batched device call.
+    """
+
+    def __init__(self, nr_eval: int, build_player: Callable[[int], object]):
+        self.nr_eval = nr_eval
+        self.build_player = build_player
+
+    def trigger_epoch(self):
+        from distributed_ba3c_tpu.train.eval import eval_model
+
+        mean, mx = eval_model(
+            self.trainer.predictor_fn(),
+            self.build_player,
+            self.nr_eval,
+        )
+        self.trainer.stat_holder.add_stat("eval_mean_score", mean)
+        self.trainer.stat_holder.add_stat("eval_max_score", mx)
+        logger.info("eval: mean=%.3f max=%.3f over %d eps", mean, mx, self.nr_eval)

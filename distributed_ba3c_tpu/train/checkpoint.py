@@ -1,0 +1,93 @@
+"""Checkpoint/resume via orbax, with keep-best semantics.
+
+Reference equivalent (SURVEY.md §5 checkpoint/resume): ``ModelSaver`` →
+``tf.train.Saver`` periodic writes, ``MaxSaver`` keep-best-score copy,
+``--load`` → ``SaverRestore``. Here: orbax saves of the full TrainState
+(params + opt state + step), a ``latest`` pointer, and a ``best`` pointer
+updated when the monitored stat improves.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Saves/restores TrainState pytrees under ``root/ckpt-<step>``."""
+
+    def __init__(self, root: str, max_to_keep: int = 3):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._ckpt = ocp.StandardCheckpointer()
+        self.max_to_keep = max_to_keep
+        self._meta_path = os.path.join(self.root, "checkpoint.json")
+        self._meta = {"all": [], "latest": None, "best": None, "best_score": None}
+        if os.path.isfile(self._meta_path):
+            with open(self._meta_path) as f:
+                self._meta = json.load(f)
+
+    def _write_meta(self):
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._meta, f)
+        os.replace(tmp, self._meta_path)
+
+    def _dir(self, step: int) -> str:
+        return os.path.join(self.root, f"ckpt-{step}")
+
+    def save(self, state: Any, step: int) -> str:
+        path = self._dir(step)
+        self._ckpt.save(path, jax.device_get(state), force=True)
+        # StandardCheckpointer is async in this orbax version; commit before
+        # pruning/meta so `latest` never points at an in-flight write.
+        wait = getattr(self._ckpt, "wait_until_finished", None)
+        if callable(wait):
+            wait()
+        self._meta["all"].append(step)
+        self._meta["latest"] = step
+        # prune oldest beyond max_to_keep (never prune the best)
+        while len(self._meta["all"]) > self.max_to_keep:
+            victim = self._meta["all"].pop(0)
+            if victim == self._meta.get("best"):
+                self._meta["all"].insert(1, victim)  # keep best, try next
+                if len(self._meta["all"]) <= self.max_to_keep:
+                    break
+                victim = self._meta["all"].pop(0)
+            vdir = self._dir(victim)
+            if os.path.isdir(vdir):
+                import shutil
+
+                shutil.rmtree(vdir)
+        self._write_meta()
+        return path
+
+    def mark_best(self, step: int, score: float) -> bool:
+        """Record ``step`` as best if ``score`` improves; returns True if so."""
+        best = self._meta.get("best_score")
+        if best is None or score > best:
+            self._meta["best"] = step
+            self._meta["best_score"] = float(score)
+            self._write_meta()
+            return True
+        return False
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self._meta.get("latest")
+
+    @property
+    def best_step(self) -> Optional[int]:
+        return self._meta.get("best")
+
+    def restore(self, target: Any, step: Optional[int] = None) -> Any:
+        """Restore into the structure of ``target`` (an abstract or concrete
+        TrainState). Defaults to the latest step."""
+        if step is None:
+            step = self.latest_step
+        assert step is not None, "no checkpoint to restore"
+        return self._ckpt.restore(self._dir(step), target)

@@ -1,0 +1,62 @@
+"""Eval/play: run episodes with the trained policy.
+
+Reference equivalent: ``src/common.py`` — ``play_one_episode``,
+``eval_with_funcs``, ``play_n_episodes`` (SURVEY.md §2.1 #4, call stack §3.5).
+TPU-native redesign: instead of one thread per eval player each doing a
+single-state forward, E players step in lockstep and every forward is one
+batched device call.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Tuple
+
+import numpy as np
+
+
+def play_one_episode(
+    player, predict: Callable[[np.ndarray], int], max_steps: int = 100000
+) -> float:
+    """Play a full episode; returns the score. ``predict(state) -> action``."""
+    score = 0.0
+    for _ in range(max_steps):
+        act = predict(player.current_state())
+        r, is_over = player.action(act)
+        score += r
+        if is_over:
+            return score
+    return score
+
+
+def eval_model(
+    predict_batch: Callable[[np.ndarray], np.ndarray],
+    build_player: Callable[[int], object],
+    nr_eval: int,
+    max_steps: int = 100000,
+) -> Tuple[float, float]:
+    """Play ``nr_eval`` episodes in lockstep; returns (mean, max) score.
+
+    ``predict_batch(states [E, ...]) -> actions [E]`` (greedy).
+    """
+    players = [build_player(1000 + i) for i in range(nr_eval)]
+    scores = np.zeros(nr_eval)
+    done = np.zeros(nr_eval, bool)
+    for _ in range(max_steps):
+        states = np.stack([p.current_state() for p in players])
+        actions = predict_batch(states)
+        for i, p in enumerate(players):
+            if done[i]:
+                continue
+            r, over = p.action(int(actions[i]))
+            scores[i] += r
+            done[i] = done[i] or over
+        if done.all():
+            break
+    return float(scores.mean()), float(scores.max())
+
+
+def play_n_episodes(
+    player, predict: Callable[[np.ndarray], int], nr: int
+) -> List[float]:
+    """Sequential episode playback (reference ``play_n_episodes``)."""
+    return [play_one_episode(player, predict) for _ in range(nr)]

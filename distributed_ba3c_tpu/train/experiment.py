@@ -1,0 +1,66 @@
+"""Experiment-tracking channels (Neptune-equivalent surface).
+
+Reference equivalent: the deepsense Neptune integration (SURVEY.md §2.7 #24)
+— live channels (score, cost, fps) streamed from the run. Rebuild: a
+dependency-free JSONL channel writer with the same shape (named channels of
+(x, y) points), pluggable into the callback list; any dashboard can tail the
+file. TensorBoard: point `jax.profiler`/TensorBoard at the logdir for device
+traces (utils/profiling.py); scalar history lives in stat.json + channels.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from distributed_ba3c_tpu.train.callbacks import Callback
+
+
+class ChannelWriter:
+    """Append-only JSONL: one line per point {channel, x, y, ts}."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", buffering=1)
+
+    def send(self, channel: str, x: float, y: float) -> None:
+        self._f.write(
+            json.dumps(
+                {"channel": channel, "x": x, "y": y, "ts": time.time()}
+            )
+            + "\n"
+        )
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class ExperimentLogger(Callback):
+    """Streams the stat_holder's per-epoch record to channels.jsonl."""
+
+    def __init__(self, channels=("mean_score", "max_score", "fps", "loss")):
+        self.channels = channels
+        self._writer: Optional[ChannelWriter] = None
+
+    def before_train(self):
+        log_dir = self.trainer.config.log_dir
+        if log_dir:
+            self._writer = ChannelWriter(os.path.join(log_dir, "channels.jsonl"))
+
+    def trigger_epoch(self):
+        if self._writer is None:
+            return
+        # read the just-finalized record (StatPrinter runs before this
+        # callback in the standard ordering; see cli.py callback order note)
+        if self.trainer.stat_holder.stat_history:
+            rec = self.trainer.stat_holder.stat_history[-1]
+            x = rec.get("global_step", self.trainer.global_step)
+            for ch in self.channels:
+                if ch in rec:
+                    self._writer.send(ch, x, rec[ch])
+
+    def after_train(self):
+        if self._writer is not None:
+            self._writer.close()

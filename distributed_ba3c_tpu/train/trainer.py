@@ -1,0 +1,158 @@
+"""The trainer: epochs × steps main loop over the mesh-sharded update.
+
+Reference equivalent (SURVEY.md §2.5 #13-15, call stack §3.1):
+``Trainer.train() -> main_loop()`` with callback dispatch. What changed,
+TPU-first:
+
+- ``run_step``'s ``sess.run(train_op)`` + async PS gradient push becomes one
+  jitted shard_map step with the grads psum'd over the mesh (§3.4 replaced).
+- ``QueueInput``/``EnqueueThread`` become ``TrainFeed`` (host batcher thread)
+  + async ``jax.device_put`` against the batch sharding, so H2D overlaps the
+  device step.
+- The predict towers' shared-variable reads become an explicit params publish
+  to the BatchedPredictor every ``publish_every`` steps (on-device ref swap,
+  no host copy).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_ba3c_tpu.config import BA3CConfig
+from distributed_ba3c_tpu.train.callbacks import Callback, Callbacks
+from distributed_ba3c_tpu.utils import logger
+from distributed_ba3c_tpu.utils.stats import StatCounter, StatHolder
+
+
+@dataclasses.dataclass
+class TrainLoopConfig:
+    """Loop shape + wiring (reference ``TrainConfig``, SURVEY.md §2.5 #13)."""
+
+    steps_per_epoch: int = 1000
+    max_epoch: int = 100
+    log_dir: Optional[str] = None
+    publish_every: int = 1  # params → predictor every N steps
+    feed_timeout: float = 120.0
+
+
+class Trainer:
+    """Owns the TrainState, the jitted step, and the callback lifecycle."""
+
+    def __init__(
+        self,
+        config: TrainLoopConfig,
+        cfg: BA3CConfig,
+        step_fn: Callable,  # from make_train_step
+        state,  # TrainState (host or device)
+        feed,  # TrainFeed-like: next_batch(timeout)
+        callbacks: List[Callback],
+        predictor=None,  # BatchedPredictor to publish params to
+        score_queue: Optional[queue.Queue] = None,
+        is_chief: bool = True,
+        samples_per_step: Optional[int] = None,
+    ):
+        self.config = config
+        self.cfg = cfg
+        self.step_fn = step_fn
+        self.state = jax.device_put(state, step_fn.state_sharding)
+        self.feed = feed
+        self.predictor = predictor
+        self.score_queue = score_queue
+        self.is_chief = is_chief
+
+        self.hyperparams: Dict[str, float] = {
+            "learning_rate": cfg.learning_rate,
+            "entropy_beta": cfg.entropy_beta,
+        }
+        self.global_step = 0
+        self.epoch_num = 0
+        self.batch_size = samples_per_step or cfg.batch_size
+        self.stat_holder = StatHolder(config.log_dir)
+        self.score_counter: Optional[StatCounter] = StatCounter()
+        self.last_mean_score: Optional[float] = None
+        self.ckpt_manager = None  # set by ModelSaver
+        self.metrics = None
+        self._callbacks = Callbacks(callbacks)
+
+    # -- predictor glue ----------------------------------------------------
+    def predictor_fn(self) -> Callable[[np.ndarray], np.ndarray]:
+        """Greedy batched predict on CURRENT params (for Evaluator)."""
+        assert self.predictor is not None
+
+        def predict(states: np.ndarray) -> np.ndarray:
+            _, _, logits = self.predictor.predict_batch(states)
+            return logits.argmax(-1)
+
+        return predict
+
+    def _publish_params(self):
+        if self.predictor is not None:
+            # COPY before publishing: the train step donates the state buffers
+            # (donate_argnums), so the predictor must never alias them — an
+            # in-flight forward reading a donated-and-reused buffer crashes in
+            # native code. The copy is one small device-to-device transfer.
+            params = jax.tree_util.tree_map(jnp.copy, self.state.params)
+            self.predictor.update_params(params)
+
+    def _drain_scores(self):
+        if self.score_queue is None:
+            return
+        while True:
+            try:
+                self.score_counter.feed(self.score_queue.get_nowait())
+            except queue.Empty:
+                return
+
+    # -- loop --------------------------------------------------------------
+    def run_step(self) -> None:
+        batch = self.feed.next_batch(timeout=self.config.feed_timeout)
+        sharding = self.step_fn.batch_sharding
+        if isinstance(sharding, dict):
+            batch = {k: jax.device_put(v, sharding[k]) for k, v in batch.items()}
+        else:
+            batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
+        self.state, self.metrics = self.step_fn(
+            self.state,
+            batch,
+            self.hyperparams["entropy_beta"],
+            self.hyperparams["learning_rate"],
+        )
+        self.global_step += 1
+        if self.global_step % self.config.publish_every == 0:
+            self._publish_params()
+        self._drain_scores()
+        self._callbacks.trigger_step(self.metrics)
+
+    def train(self) -> None:
+        self._callbacks.setup(self)
+        if self.config.log_dir:
+            logger.set_logger_dir(self.config.log_dir)
+        self._callbacks.before_train()
+        self._publish_params()
+        try:
+            for self.epoch_num in range(1, self.config.max_epoch + 1):
+                for _ in range(self.config.steps_per_epoch):
+                    self.run_step()
+                self._callbacks.trigger_epoch()
+        except (KeyboardInterrupt, queue.Empty):
+            logger.warn("training interrupted")
+        finally:
+            self._callbacks.after_train()
+
+    # -- resume ------------------------------------------------------------
+    def restore(self, ckpt_dir: str, step: Optional[int] = None) -> None:
+        """Resume params/opt/step from a checkpoint directory (--load)."""
+        from distributed_ba3c_tpu.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(ckpt_dir)
+        restored = mgr.restore(jax.device_get(self.state), step)
+        self.state = jax.device_put(restored, self.step_fn.state_sharding)
+        self.global_step = int(self.state.step)
+        self._publish_params()
+        logger.info("restored checkpoint at step %d", self.global_step)

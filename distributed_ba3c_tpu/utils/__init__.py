@@ -1,0 +1,8 @@
+"""Host-side utilities: serialization, concurrency, logging, stats.
+
+Reference equivalent: ``src/tensorpack/utils/`` (SURVEY.md §2.8 #25-28).
+"""
+
+from distributed_ba3c_tpu.utils.serialize import dumps, loads
+
+__all__ = ["dumps", "loads"]

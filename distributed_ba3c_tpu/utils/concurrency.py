@@ -1,0 +1,115 @@
+"""Process/thread lifecycle helpers for the actor plane.
+
+Reference equivalent: ``tensorpack/utils/concurrency.py`` —
+``ensure_proc_terminate``, ``StoppableThread``, ``LoopThread``, SIGINT masking
+in children (SURVEY.md §2.8 #26). Concurrency safety here, as in the
+reference, is by construction: message passing between processes, queues
+between threads, no shared mutable state.
+"""
+
+from __future__ import annotations
+
+import atexit
+import multiprocessing as mp
+import queue
+import signal
+import threading
+import weakref
+from contextlib import contextmanager
+from typing import Callable, Iterable, Optional, Union
+
+
+class StoppableThread(threading.Thread):
+    """Thread with a cooperative stop flag and stop-aware queue helpers."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._stop_evt = threading.Event()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+
+    def stopped(self) -> bool:
+        return self._stop_evt.is_set()
+
+    def queue_put_stoppable(self, q: queue.Queue, obj, timeout: float = 0.5) -> bool:
+        """Put, retrying until success or stop(); returns False if stopped."""
+        while not self.stopped():
+            try:
+                q.put(obj, timeout=timeout)
+                return True
+            except queue.Full:
+                pass
+        return False
+
+    def queue_get_stoppable(self, q: queue.Queue, timeout: float = 0.5):
+        """Get, retrying until success or stop(); returns None if stopped."""
+        while not self.stopped():
+            try:
+                return q.get(timeout=timeout)
+            except queue.Empty:
+                pass
+        return None
+
+
+class LoopThread(StoppableThread):
+    """Calls ``func`` in a loop until stopped."""
+
+    def __init__(self, func: Callable[[], None], daemon: bool = True):
+        super().__init__(daemon=daemon)
+        self._func = func
+
+    def run(self) -> None:
+        while not self.stopped():
+            self._func()
+
+
+def ensure_proc_terminate(
+    proc: Union[mp.Process, Iterable[mp.Process]],
+) -> None:
+    """Register an atexit hook that terminates the process(es).
+
+    Simulator processes must not outlive the trainer (the reference had the
+    same problem with 50 ALE processes per worker).
+    """
+    if not isinstance(proc, mp.process.BaseProcess):
+        for p in proc:
+            ensure_proc_terminate(p)
+        return
+
+    ref = weakref.ref(proc)
+
+    def stop():
+        p = ref()
+        if p is None or not p.is_alive():
+            return
+        p.terminate()
+        p.join(timeout=5)
+        if p.is_alive():
+            p.kill()
+
+    atexit.register(stop)
+
+
+@contextmanager
+def mask_sigint():
+    """Block SIGINT so forked children don't receive the trainer's Ctrl-C."""
+    if threading.current_thread() is threading.main_thread():
+        old = signal.signal(signal.SIGINT, signal.SIG_IGN)
+        try:
+            yield
+        finally:
+            signal.signal(signal.SIGINT, old)
+    else:
+        yield
+
+
+def start_proc_mask_signal(
+    procs: Union[mp.Process, Iterable[mp.Process]],
+) -> None:
+    """Start process(es) with SIGINT masked (children ignore Ctrl-C)."""
+    if isinstance(procs, mp.process.BaseProcess):
+        procs = [procs]
+    with mask_sigint():
+        for p in procs:
+            p.start()

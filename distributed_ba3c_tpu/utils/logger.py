@@ -1,0 +1,65 @@
+"""Console + file logger with auto log-dir.
+
+Reference equivalent: ``tensorpack/utils/logger.py`` (SURVEY.md §2.8 #27).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+_LOGGER = logging.getLogger("ba3c")
+_LOGGER.propagate = False
+LOG_DIR: Optional[str] = None
+
+_COLORS = {"WARNING": "\033[33m", "ERROR": "\033[31m", "CRITICAL": "\033[31m"}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record):
+        msg = super().format(record)
+        color = _COLORS.get(record.levelname)
+        if color and sys.stderr.isatty():
+            return f"{color}{msg}{_RESET}"
+        return msg
+
+
+def _ensure_console_handler():
+    if not _LOGGER.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(
+            _ColorFormatter("[%(asctime)s %(levelname)s] %(message)s", "%H:%M:%S")
+        )
+        _LOGGER.addHandler(h)
+        _LOGGER.setLevel(logging.INFO)
+
+
+def set_logger_dir(dirname: str, action: str = "k") -> None:
+    """Attach a file handler writing to ``dirname/log.log``; create the dir."""
+    global LOG_DIR
+    _ensure_console_handler()
+    os.makedirs(dirname, exist_ok=True)
+    LOG_DIR = dirname
+    fh = logging.FileHandler(os.path.join(dirname, "log.log"))
+    fh.setFormatter(
+        logging.Formatter("[%(asctime)s %(levelname)s] %(message)s", "%H:%M:%S")
+    )
+    _LOGGER.addHandler(fh)
+
+
+def info(msg, *a):
+    _ensure_console_handler()
+    _LOGGER.info(msg, *a)
+
+
+def warn(msg, *a):
+    _ensure_console_handler()
+    _LOGGER.warning(msg, *a)
+
+
+def error(msg, *a):
+    _ensure_console_handler()
+    _LOGGER.error(msg, *a)

@@ -1,0 +1,60 @@
+"""Tracing/profiling hooks.
+
+Reference equivalent (SURVEY.md §5): nothing built-in beyond
+``utils/timer.py`` ``timed_operation`` — op-level profiling was offline
+(VTune/TF timeline). The rebuild does better with the tools XLA ships:
+
+- :func:`timed_operation` — the reference's host-side timer, kept API-alike.
+- :func:`start_server` — ``jax.profiler`` trace server; connect TensorBoard
+  or ``jax.profiler.trace`` to capture device timelines (HLO op breakdown,
+  ICI collective time) from a live run.
+- :func:`step_annotation` — wraps a train step in a named trace region so
+  captures show per-step boundaries.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+from distributed_ba3c_tpu.utils import logger
+
+
+@contextlib.contextmanager
+def timed_operation(msg: str, log_start: bool = False):
+    """Log the wall-clock duration of a block (reference ``timed_operation``)."""
+    if log_start:
+        logger.info("start %s ...", msg)
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        logger.info("%s finished, time:%.4f sec.", msg, time.time() - t0)
+
+
+def start_server(port: int) -> None:
+    """Start the jax.profiler gRPC server (TensorBoard-attachable)."""
+    import jax
+
+    jax.profiler.start_server(port)
+    logger.info("jax.profiler server listening on :%d", port)
+
+
+@contextlib.contextmanager
+def step_annotation(name: str, step: int):
+    """Named trace region for one step (shows up in captured timelines)."""
+    import jax
+
+    with jax.profiler.StepTraceAnnotation(name, step_num=step):
+        yield
+
+
+def capture_trace(log_dir: str, seconds: float, fn, *args, **kwargs):
+    """Run ``fn`` under a trace capture written to ``log_dir`` (offline use)."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+    logger.info("trace written to %s", log_dir)
+    return out

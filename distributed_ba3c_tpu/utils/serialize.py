@@ -1,0 +1,54 @@
+"""msgpack serialization with zero-copy numpy support.
+
+Reference equivalent: ``tensorpack/utils/serialize.py`` — msgpack +
+msgpack_numpy ``dumps``/``loads`` used for every ZMQ payload (SURVEY.md §2.8
+#25, §2.12). msgpack_numpy is not installed here, so ndarrays are encoded as a
+msgpack ext type carrying (dtype, shape, raw bytes); uint8 frames therefore
+cross the wire at 1 byte/pixel with no base64/pickle overhead, matching the
+reference's design intent.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import msgpack
+import numpy as np
+
+_NDARRAY_EXT = 42
+
+
+def _default(obj: Any):
+    if isinstance(obj, np.ndarray):
+        if not obj.flags["C_CONTIGUOUS"]:
+            obj = np.ascontiguousarray(obj)
+        header = msgpack.packb((obj.dtype.str, obj.shape), use_bin_type=True)
+        return msgpack.ExtType(_NDARRAY_EXT, header + obj.tobytes())
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, (np.bool_,)):
+        return bool(obj)
+    raise TypeError(f"cannot serialize {type(obj)}")
+
+
+def _ext_hook(code: int, data: bytes):
+    if code != _NDARRAY_EXT:
+        return msgpack.ExtType(code, data)
+    unpacker = msgpack.Unpacker(use_list=False, raw=False)
+    unpacker.feed(data)
+    dtype_str, shape = unpacker.unpack()
+    offset = unpacker.tell()
+    arr = np.frombuffer(data, dtype=np.dtype(dtype_str), offset=offset)
+    return arr.reshape(shape)
+
+
+def dumps(obj: Any) -> bytes:
+    """Serialize to msgpack bytes (ndarray-aware)."""
+    return msgpack.packb(obj, use_bin_type=True, default=_default)
+
+
+def loads(buf: bytes) -> Any:
+    """Inverse of :func:`dumps`. Arrays are views over the input buffer."""
+    return msgpack.unpackb(buf, raw=False, ext_hook=_ext_hook)

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Multi-host launcher: the reference's cluster launch surface (SURVEY.md §2.8
+# #29 — srun/ssh fan-out building --ps_hosts/--worker_hosts lists), minus the
+# ps tier (obsolete on TPU; gradients ride ICI/DCN collectives).
+#
+# Usage:
+#   scripts/launch_multihost.sh "host1:9900,host2:9900" [train.py args...]
+#
+# Runs this host's worker: rank = position of $(hostname) in the list.
+# Under Slurm, simply:  srun scripts/launch_multihost.sh "$WORKER_HOSTS" ...
+# (every task computes its own rank the same way; SLURM_PROCID overrides).
+set -euo pipefail
+
+WORKER_HOSTS="${1:?usage: launch_multihost.sh host1:p,host2:p [args...]}"
+shift
+
+if [[ -n "${SLURM_PROCID:-}" ]]; then
+  TASK_INDEX="$SLURM_PROCID"
+else
+  HOSTNAME_SHORT=$(hostname -s)
+  TASK_INDEX=$(python3 - "$WORKER_HOSTS" "$HOSTNAME_SHORT" <<'EOF'
+import sys
+hosts = [h.split(":")[0].split(".")[0] for h in sys.argv[1].split(",")]
+print(hosts.index(sys.argv[2]))
+EOF
+)
+fi
+
+echo "[launch] worker_hosts=$WORKER_HOSTS task_index=$TASK_INDEX"
+exec python train.py \
+  --job_name worker \
+  --worker_hosts "$WORKER_HOSTS" \
+  --task_index "$TASK_INDEX" \
+  "$@"

@@ -1,0 +1,144 @@
+"""Callback system, schedules, stats, checkpoint manager."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_ba3c_tpu.train.callbacks import (
+    Callback,
+    Callbacks,
+    HumanHyperParamSetter,
+    HyperParamSetterWithFunc,
+    PeriodicTrigger,
+    ScheduledHyperParamSetter,
+)
+from distributed_ba3c_tpu.train.checkpoint import CheckpointManager
+from distributed_ba3c_tpu.utils.stats import StatCounter, StatHolder
+
+
+class _FakeTrainer:
+    def __init__(self, log_dir=None):
+        self.hyperparams = {}
+        self.epoch_num = 0
+        self.global_step = 0
+
+        class C:
+            pass
+
+        self.config = C()
+        self.config.log_dir = log_dir
+
+
+def test_scheduled_setter_step_interp():
+    tr = _FakeTrainer()
+    cb = ScheduledHyperParamSetter("lr", [(1, 1.0), (5, 0.5), (10, 0.1)])
+    cb.setup(tr)
+    expect = {1: 1.0, 3: 1.0, 5: 0.5, 7: 0.5, 10: 0.1, 20: 0.1}
+    for e, v in expect.items():
+        tr.epoch_num = e
+        cb.trigger_epoch()
+        assert tr.hyperparams["lr"] == pytest.approx(v), f"epoch {e}"
+
+
+def test_scheduled_setter_linear_interp():
+    tr = _FakeTrainer()
+    cb = ScheduledHyperParamSetter("beta", [(0, 0.0), (10, 1.0)], interp="linear")
+    cb.setup(tr)
+    tr.epoch_num = 5
+    cb.trigger_epoch()
+    assert tr.hyperparams["beta"] == pytest.approx(0.5)
+
+
+def test_func_setter():
+    tr = _FakeTrainer()
+    cb = HyperParamSetterWithFunc("lr", lambda e, cur: 0.1 / (e + 1))
+    cb.setup(tr)
+    tr.epoch_num = 4
+    cb.trigger_epoch()
+    assert tr.hyperparams["lr"] == pytest.approx(0.02)
+
+
+def test_human_setter(tmp_path):
+    tr = _FakeTrainer(log_dir=str(tmp_path))
+    cb = HumanHyperParamSetter("learning_rate")
+    cb.setup(tr)
+    (tmp_path / "hyper.txt").write_text("learning_rate: 0.042\nother: 1\n")
+    cb.trigger_epoch()
+    assert tr.hyperparams["learning_rate"] == pytest.approx(0.042)
+
+
+def test_periodic_trigger_epochs():
+    tr = _FakeTrainer()
+    fired = []
+
+    class Probe(Callback):
+        def trigger_epoch(self):
+            fired.append(self.trainer.epoch_num)
+
+    cb = PeriodicTrigger(Probe(), every_k_epochs=3)
+    cb.setup(tr)
+    for e in range(1, 10):
+        tr.epoch_num = e
+        cb.trigger_epoch()
+    assert fired == [3, 6, 9]
+
+
+def test_callbacks_after_train_survives_errors():
+    ran = []
+
+    class Bad(Callback):
+        def after_train(self):
+            raise RuntimeError("boom")
+
+    class Good(Callback):
+        def after_train(self):
+            ran.append(1)
+
+    group = Callbacks([Bad(), Good()])
+    group.after_train()
+    assert ran == [1]
+
+
+def test_stat_counter():
+    c = StatCounter()
+    for v in [1.0, 2.0, 6.0]:
+        c.feed(v)
+    assert c.count == 3 and c.average == 3.0 and c.max == 6.0 and c.sum == 9.0
+    c.reset()
+    assert c.count == 0
+
+
+def test_stat_holder_writes_stat_json(tmp_path):
+    h = StatHolder(str(tmp_path))
+    h.add_stat("mean_score", 1.5)
+    h.add_stat("epoch", 1)
+    h.finalize()
+    h.add_stat("mean_score", 2.5)
+    h.finalize()
+    data = json.load(open(tmp_path / "stat.json"))
+    assert [d["mean_score"] for d in data] == [1.5, 2.5]
+    # resume appends
+    h2 = StatHolder(str(tmp_path))
+    h2.add_stat("mean_score", 3.5)
+    h2.finalize()
+    data = json.load(open(tmp_path / "stat.json"))
+    assert len(data) == 3
+
+
+def test_checkpoint_manager_roundtrip_and_best(tmp_path):
+    state = {"w": np.arange(4.0), "step": np.array(7, np.int32)}
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+    mgr.save(state, 1)
+    assert mgr.mark_best(1, 10.0)
+    state["w"] = state["w"] + 1
+    mgr.save(state, 2)
+    assert not mgr.mark_best(2, 5.0)  # worse score
+    mgr.save({"w": state["w"] + 1, "step": np.array(9, np.int32)}, 3)
+    assert mgr.latest_step == 3 and mgr.best_step == 1
+
+    mgr2 = CheckpointManager(str(tmp_path / "ck"))
+    restored = mgr2.restore({"w": np.zeros(4), "step": np.array(0, np.int32)})
+    np.testing.assert_array_equal(restored["w"], np.arange(4.0) + 2)
+    assert restored["step"] == 9

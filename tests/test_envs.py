@@ -1,0 +1,82 @@
+"""Player protocol, wrappers, FakeEnv (envs/)."""
+
+import numpy as np
+
+from distributed_ba3c_tpu.envs import (
+    FakeEnv,
+    HistoryFramePlayer,
+    LimitLengthPlayer,
+    PreventStuckPlayer,
+)
+
+
+def test_fake_env_optimal_policy():
+    env = FakeEnv(chain_len=4, max_steps=16, image_size=(16, 16), noise=0)
+    total, steps = 0.0, 0
+    for _ in range(3):  # three episodes of always-right
+        while True:
+            r, over = env.action(1)
+            total += r
+            steps += 1
+            if over:
+                break
+    assert total == 3.0
+    assert steps == 3 * 3  # chain_len-1 per episode
+
+
+def test_fake_env_timeout_and_autorestart():
+    env = FakeEnv(chain_len=4, max_steps=5, image_size=(16, 16), noise=0)
+    rewards = [env.action(0) for _ in range(5)]  # always-left never scores
+    assert rewards[-1] == (0.0, True)
+    assert env.pos == 0 and env.steps == 0  # auto-restarted
+
+
+def test_fake_env_observation_encodes_position():
+    env = FakeEnv(chain_len=4, image_size=(16, 16), noise=0)
+    s0 = env.current_state()
+    env.action(1)
+    s1 = env.current_state()
+    assert s0.shape == (16, 16) and s0.dtype == np.uint8
+    assert not np.array_equal(s0, s1)
+    # bright band moved right
+    assert s0[:, 0:4].min() == 230 and s1[:, 4:8].min() == 230
+
+
+def test_history_player_stacks_and_clears():
+    env = FakeEnv(chain_len=3, max_steps=8, image_size=(8, 8), noise=0)
+    p = HistoryFramePlayer(env, 4)
+    s = p.current_state()
+    assert s.shape == (8, 8, 4)
+    # first state: 3 zero frames + 1 real frame
+    assert s[..., :3].max() == 0 and s[..., 3].max() == 230
+    p.action(1)
+    assert p.current_state()[..., 2:].max() == 230
+    # finish the episode; history must reset to fresh-episode padding
+    _, over = p.action(1)
+    assert over
+    s = p.current_state()
+    assert s[..., :3].max() == 0
+
+
+def test_limit_length_player():
+    env = FakeEnv(chain_len=10, max_steps=1000, image_size=(8, 8), noise=0)
+    p = LimitLengthPlayer(env, limit=7)
+    n = 0
+    while True:
+        _, over = p.action(3)  # no-op action never ends naturally
+        n += 1
+        if over:
+            break
+    assert n == 7
+
+
+def test_prevent_stuck_player():
+    env = FakeEnv(chain_len=4, max_steps=100, image_size=(8, 8), noise=0)
+    p = PreventStuckPlayer(env, limit=3, action_on_stuck=1)
+    # feed no-ops; after 3 identical observations the wrapper forces action 1
+    for _ in range(30):
+        _, over = p.action(3)
+        if over:
+            break
+    # the forced right-moves must eventually reach the goal (reward episode end)
+    assert env.stats["score"] and env.stats["score"][0] == 1.0

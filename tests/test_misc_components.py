@@ -1,0 +1,92 @@
+"""Gym adapter, symbolic ops, experiment channels, launch script sanity."""
+
+import json
+import os
+import subprocess
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_ba3c_tpu.ops.symbolic import accuracy, huber_loss, prediction_incorrect
+
+
+def test_huber_loss_regions():
+    x = jnp.array([-3.0, -0.5, 0.0, 0.5, 3.0])
+    out = huber_loss(x, delta=1.0)
+    np.testing.assert_allclose(
+        np.asarray(out), [2.5, 0.125, 0.0, 0.125, 2.5], rtol=1e-6
+    )
+
+
+def test_prediction_incorrect_and_accuracy():
+    logits = jnp.array([[1.0, 2.0, 0.0], [5.0, 1.0, 0.0]])
+    labels = jnp.array([1, 2])
+    err = prediction_incorrect(logits, labels)
+    np.testing.assert_array_equal(np.asarray(err), [0.0, 1.0])
+    assert float(accuracy(logits, labels)) == pytest.approx(0.5)
+
+
+def test_gym_adapter_cartpole():
+    gym = pytest.importorskip("gymnasium")
+    from distributed_ba3c_tpu.envs.gym_adapter import GymEnv
+
+    env = GymEnv("CartPole-v1", seed=0)
+    assert env.get_action_space_size() == 2
+    s = env.current_state()
+    assert s.shape == (4,)
+    total_eps = 0
+    for _ in range(300):
+        r, over = env.action(np.random.default_rng(0).integers(0, 2))
+        if over:
+            total_eps += 1
+    assert total_eps >= 1
+    assert len(env.stats["score"]) == total_eps
+
+
+def test_channel_writer_and_logger(tmp_path):
+    from distributed_ba3c_tpu.train.experiment import ChannelWriter, ExperimentLogger
+    from distributed_ba3c_tpu.utils.stats import StatHolder
+
+    path = str(tmp_path / "channels.jsonl")
+    w = ChannelWriter(path)
+    w.send("score", 1, 2.5)
+    w.send("fps", 1, 1000.0)
+    w.close()
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0] == pytest.approx(
+        {"channel": "score", "x": 1, "y": 2.5, "ts": lines[0]["ts"]}
+    )
+
+    class _T:
+        pass
+
+    tr = _T()
+    tr.global_step = 7
+
+    class C:
+        log_dir = str(tmp_path)
+
+    tr.config = C()
+    tr.stat_holder = StatHolder(str(tmp_path))
+    tr.stat_holder.add_stat("mean_score", 3.0)
+    tr.stat_holder.add_stat("global_step", 7)
+    tr.stat_holder.finalize()
+
+    cb = ExperimentLogger()
+    cb.setup(tr)
+    cb.before_train()
+    cb.trigger_epoch()
+    cb.after_train()
+    recs = [json.loads(l) for l in open(tmp_path / "channels.jsonl")]
+    assert any(r["channel"] == "mean_score" and r["y"] == 3.0 for r in recs)
+
+
+def test_launch_script_rank_computation():
+    out = subprocess.run(
+        ["bash", "-c", 'python3 - "h1:1,h2:1,h3:1" h2 <<\'EOF\'\nimport sys\nhosts=[h.split(":")[0].split(".")[0] for h in sys.argv[1].split(",")]\nprint(hosts.index(sys.argv[2]))\nEOF'],
+        capture_output=True,
+        text=True,
+    )
+    assert out.stdout.strip() == "1"
+    assert os.access("scripts/launch_multihost.sh", os.R_OK)

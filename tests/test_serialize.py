@@ -1,0 +1,44 @@
+"""Serialization round-trips (utils/serialize.py)."""
+
+import numpy as np
+
+from distributed_ba3c_tpu.utils.serialize import dumps, loads
+
+
+def test_scalar_roundtrip():
+    obj = [b"ident-3", 1.5, True, None, "x", 7]
+    assert loads(dumps(obj)) == obj
+
+
+def test_ndarray_roundtrip():
+    arr = np.arange(84 * 84 * 4, dtype=np.uint8).reshape(84, 84, 4)
+    out = loads(dumps(arr))
+    assert out.dtype == arr.dtype and out.shape == arr.shape
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_mixed_payload_roundtrip():
+    state = np.random.default_rng(0).integers(0, 255, (84, 84), np.uint8)
+    ident, reward, is_over = b"simulator-0", -1.25, False
+    i2, s2, r2, o2 = loads(dumps([ident, state, reward, is_over]))
+    assert i2 == ident and r2 == reward and o2 == is_over
+    np.testing.assert_array_equal(s2, state)
+
+
+def test_noncontiguous_and_float_arrays():
+    arr = np.arange(24, dtype=np.float32).reshape(4, 6)[:, ::2]
+    out = loads(dumps(arr))
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_numpy_scalars():
+    assert loads(dumps([np.float32(1.5), np.int64(3), np.bool_(True)])) == [
+        1.5,
+        3,
+        True,
+    ]
+
+
+def test_uint8_wire_overhead_is_small():
+    arr = np.zeros((84, 84, 4), np.uint8)
+    assert len(dumps(arr)) < arr.nbytes + 64

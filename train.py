@@ -1,0 +1,11 @@
+#!/usr/bin/env python
+"""Training entry point — the reference's ``src/train.py`` CLI surface
+(SURVEY.md §1 L7) over the TPU-native stack. See ``python train.py --help``.
+"""
+
+import sys
+
+from distributed_ba3c_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())
