@@ -33,8 +33,9 @@ class BA3CSimulatorMaster(SimulatorMaster):
         local_time_max: int = 5,
         train_queue: Optional[queue.Queue] = None,
         score_queue: Optional[queue.Queue] = None,
+        actor_timeout: Optional[float] = None,
     ):
-        super().__init__(pipe_c2s, pipe_s2c)
+        super().__init__(pipe_c2s, pipe_s2c, actor_timeout=actor_timeout)
         self.predictor = predictor
         self.gamma = gamma
         self.local_time_max = local_time_max

@@ -50,10 +50,15 @@ class ClientState:
     __slots__ = ("memory", "ident", "score", "last_seen")
 
     def __init__(self, ident: bytes):
+        import time as _time
+
         self.ident = ident
         self.memory: List[TransitionExperience] = []
         self.score = 0.0
-        self.last_seen = 0.0
+        # initialized to creation time so a client that NEVER sends again
+        # (e.g. resurrected by a late predictor callback after pruning) still
+        # ages out instead of being exempt forever
+        self.last_seen = _time.time()
 
 
 def default_pipes(name: str = "ba3c") -> tuple[str, str]:
@@ -199,7 +204,7 @@ class SimulatorMaster(threading.Thread):
         dead = [
             ident
             for ident, c in self.clients.items()
-            if c.last_seen and now - c.last_seen > self.actor_timeout
+            if now - c.last_seen > self.actor_timeout
         ]
         for ident in dead:
             del self.clients[ident]

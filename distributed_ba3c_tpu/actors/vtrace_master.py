@@ -49,8 +49,9 @@ class VTraceSimulatorMaster(SimulatorMaster):
         unroll_len: int = 5,
         train_queue: Optional[queue.Queue] = None,
         score_queue: Optional[queue.Queue] = None,
+        actor_timeout: Optional[float] = None,
     ):
-        super().__init__(pipe_c2s, pipe_s2c)
+        super().__init__(pipe_c2s, pipe_s2c, actor_timeout=actor_timeout)
         self.predictor = predictor
         self.unroll_len = unroll_len
         self.queue: queue.Queue = train_queue or queue.Queue(maxsize=1024)

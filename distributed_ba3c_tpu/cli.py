@@ -237,6 +237,7 @@ def main(argv: Optional[list] = None) -> int:
             predictor,
             unroll_len=cfg.local_time_max,
             score_queue=score_q,
+            actor_timeout=args.actor_timeout or None,
         )
         # segments per batch: ~batch_size transitions, divisible by data axis
         n_seg = max(1, cfg.batch_size // cfg.local_time_max)
@@ -252,10 +253,10 @@ def main(argv: Optional[list] = None) -> int:
             gamma=cfg.gamma,
             local_time_max=cfg.local_time_max,
             score_queue=score_q,
+            actor_timeout=args.actor_timeout or None,
         )
         feed = TrainFeed(master.queue, cfg.batch_size)
         samples_per_step = cfg.batch_size
-    master.actor_timeout = args.actor_timeout or None
     if args.env.startswith("cpp:"):
         # batched native servers: each process hosts up to 16 envs in lockstep
         from distributed_ba3c_tpu.envs import native

@@ -14,11 +14,17 @@ Episodes auto-restart on done (same contract as the host player protocol,
 envs/base.py) so rollout scans never branch.
 """
 
-from distributed_ba3c_tpu.envs.jaxenv import breakout, pong
+from distributed_ba3c_tpu.envs.jaxenv import breakout, coinrun, pong, qbert, seaquest
 
 
 def get_env(name: str):
-    envs = {"pong": pong, "breakout": breakout}
+    envs = {
+        "pong": pong,
+        "breakout": breakout,
+        "seaquest": seaquest,
+        "qbert": qbert,
+        "coinrun": coinrun,
+    }
     if name not in envs:
         raise ValueError(f"unknown jax env {name!r}; have {sorted(envs)}")
     return envs[name]

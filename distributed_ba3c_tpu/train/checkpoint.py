@@ -50,19 +50,21 @@ class CheckpointManager:
             wait()
         self._meta["all"].append(step)
         self._meta["latest"] = step
-        # prune oldest beyond max_to_keep (never prune the best)
-        while len(self._meta["all"]) > self.max_to_keep:
-            victim = self._meta["all"].pop(0)
-            if victim == self._meta.get("best"):
-                self._meta["all"].insert(1, victim)  # keep best, try next
-                if len(self._meta["all"]) <= self.max_to_keep:
-                    break
-                victim = self._meta["all"].pop(0)
+        # prune oldest beyond max_to_keep; NEVER delete the best or the
+        # just-saved latest (with max_to_keep=1 the old loop could delete the
+        # checkpoint it had just written while `latest` still pointed at it)
+        protected = {self._meta.get("best"), step}
+        keep = list(self._meta["all"])
+        deletable = [s for s in keep if s not in protected]
+        while len(keep) > self.max_to_keep and deletable:
+            victim = deletable.pop(0)
+            keep.remove(victim)
             vdir = self._dir(victim)
             if os.path.isdir(vdir):
                 import shutil
 
                 shutil.rmtree(vdir)
+        self._meta["all"] = keep
         self._write_meta()
         return path
 

@@ -140,8 +140,16 @@ class Trainer:
                 for _ in range(self.config.steps_per_epoch):
                     self.run_step()
                 self._callbacks.trigger_epoch()
-        except (KeyboardInterrupt, queue.Empty):
+        except KeyboardInterrupt:
             logger.warn("training interrupted")
+        except queue.Empty:
+            # feed starvation is a FAILURE (dead actor plane), not a clean
+            # shutdown — propagate so launchers/CI see a non-zero exit
+            logger.error(
+                "train feed starved for %.0fs — actor plane dead?",
+                self.config.feed_timeout,
+            )
+            raise RuntimeError("train feed starved; actor plane dead") from None
         finally:
             self._callbacks.after_train()
 

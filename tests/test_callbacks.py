@@ -142,3 +142,22 @@ def test_checkpoint_manager_roundtrip_and_best(tmp_path):
     restored = mgr2.restore({"w": np.zeros(4), "step": np.array(0, np.int32)})
     np.testing.assert_array_equal(restored["w"], np.arange(4.0) + 2)
     assert restored["step"] == 9
+
+
+def test_checkpoint_max_to_keep_one_never_deletes_latest(tmp_path):
+    """Regression: with max_to_keep=1 + a protected best, the just-saved
+    checkpoint must survive (latest must always be restorable)."""
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=1)
+    state = {"w": np.arange(3.0)}
+    mgr.save(state, 1)
+    mgr.mark_best(1, 10.0)
+    mgr.save({"w": np.arange(3.0) + 1}, 2)
+    assert mgr.latest_step == 2
+    restored = CheckpointManager(str(tmp_path / "ck")).restore(
+        {"w": np.zeros(3)}
+    )
+    np.testing.assert_array_equal(restored["w"], np.arange(3.0) + 1)
+    # best is protected too
+    import os
+
+    assert os.path.isdir(tmp_path / "ck" / "ckpt-1")

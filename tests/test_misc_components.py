@@ -89,4 +89,7 @@ def test_launch_script_rank_computation():
         text=True,
     )
     assert out.stdout.strip() == "1"
-    assert os.access("scripts/launch_multihost.sh", os.R_OK)
+    script = os.path.join(
+        os.path.dirname(__file__), "..", "scripts", "launch_multihost.sh"
+    )
+    assert os.access(script, os.R_OK)
