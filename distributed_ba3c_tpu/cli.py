@@ -71,6 +71,8 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--publish_every", type=int, default=1)
     p.add_argument("--rollout_len", type=int, default=20, help="fused-trainer rollout length per update")
     p.add_argument("--actor_timeout", type=float, default=120.0, help="seconds of actor silence before its state is dropped (0=off)")
+    p.add_argument("--entropy_beta_final", type=float, default=None, help="linear-anneal entropy beta to this over max_epoch (fused trainer)")
+    p.add_argument("--learning_rate_final", type=float, default=None, help="linear-anneal LR to this over max_epoch (fused trainer)")
     p.add_argument("--profiler_port", type=int, default=0, help="start jax.profiler server on this port (0=off)")
     return p
 

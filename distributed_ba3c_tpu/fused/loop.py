@@ -307,6 +307,11 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
     state = create_fused_state(
         jax.random.PRNGKey(0), model, cfg, optimizer, env, n_envs, n_shards=n_data
     )
+    if args.load:
+        mgr = CheckpointManager(args.load)
+        restored = mgr.restore(jax.device_get(state.train))
+        state = state.replace(train=restored)
+        logger.info("resumed train state at step %d", int(restored.step))
     state = step.put(state)
 
     holder = StatHolder(args.logdir)
@@ -321,12 +326,22 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
         n_data,
     )
 
+    # runtime-scheduled hyperparams (reference ScheduledHyperParamSetter
+    # semantics): linear anneal over epochs when *_final flags are given
+    def sched(v0, v1, epoch):
+        if v1 is None or args.max_epoch <= 1:
+            return v0
+        f = (epoch - 1) / (args.max_epoch - 1)
+        return v0 + f * (v1 - v0)
+
     best = -np.inf
     for epoch in range(1, args.max_epoch + 1):
+        beta = sched(cfg.entropy_beta, args.entropy_beta_final, epoch)
+        lr = sched(cfg.learning_rate, args.learning_rate_final, epoch)
         t0 = time.time()
         metrics = None
         for _ in range(args.steps_per_epoch):
-            state, metrics = step(state, cfg.entropy_beta)
+            state, metrics = step(state, beta, lr)
         metrics = {k: float(v) for k, v in metrics.items()}
         dt = time.time() - t0
         fps = args.steps_per_epoch * samples_per_iter / dt
