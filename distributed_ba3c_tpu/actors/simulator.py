@@ -165,7 +165,12 @@ class SimulatorMaster(threading.Thread):
                     msg = self.send_queue.get(timeout=0.2)
                 except queue.Empty:
                     continue
-                self.s2c_socket.send_multipart(msg)
+                try:
+                    self.s2c_socket.send_multipart(msg)
+                except zmq.ZMQError:
+                    if self._stop_evt.is_set():
+                        return  # socket closed during teardown
+                    raise
 
         self.send_thread = threading.Thread(
             target=send_loop, daemon=True, name="SimulatorMaster-send"
@@ -189,6 +194,12 @@ class SimulatorMaster(threading.Thread):
                 self._on_message(ident, state, reward, is_over)
         except zmq.ContextTerminated:
             logger.info("SimulatorMaster context terminated")
+        except zmq.ZMQError:
+            # teardown race: close() destroyed the sockets while we polled.
+            # Only swallow when shutting down — a live-loop ZMQError is a bug.
+            if not self._stop_evt.is_set():
+                raise
+            logger.info("SimulatorMaster socket closed during shutdown")
 
     def _prune_dead_actors(self) -> None:
         """Drop state of clients silent for > actor_timeout (actor loss is
@@ -240,10 +251,20 @@ class SimulatorMaster(threading.Thread):
         self._stop_evt.set()
 
     def close(self) -> None:
-        """Stop threads and tear down ZMQ without lingering sends."""
+        """Stop threads and tear down ZMQ without lingering sends.
+
+        Idempotent; joins the receive loop BEFORE destroying the context so
+        no ZMQ background thread outlives the master (a leaked io-thread can
+        wedge later in-process jit dispatch — the round-1 pytest deadlock).
+        """
         self._stop_evt.set()
         self.send_thread.join(timeout=2)
-        self.context.destroy(linger=0)
+        if self.is_alive():
+            self.join(timeout=2)
+        try:
+            self.context.destroy(linger=0)
+        except zmq.ZMQError:
+            pass  # already destroyed
 
     @abstractmethod
     def _on_state(self, state, ident: bytes) -> None:

@@ -44,7 +44,7 @@ def make_parser() -> argparse.ArgumentParser:
     )
     # -- run mode ----------------------------------------------------------
     p.add_argument("--task", default="train", choices=["train", "eval", "play"])
-    p.add_argument("--env", default="fake", help="fake | jax:<name> (on-device env, e.g. jax:pong) | zmq:<addr> (external env server)")
+    p.add_argument("--env", default="fake", help="fake | jax:<name> (on-device env, e.g. jax:pong) | cpp:<name> (native batched core) | gym:<name> (gymnasium adapter) | zmq:<addr> (external env server)")
     p.add_argument("--load", default=None, help="checkpoint dir to resume from")
     p.add_argument("--logdir", default="train_log/ba3c")
     # -- hyperparams (reference argparse defaults, SURVEY.md §2.9) ---------
@@ -71,8 +71,8 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--publish_every", type=int, default=1)
     p.add_argument("--rollout_len", type=int, default=20, help="fused-trainer rollout length per update")
     p.add_argument("--actor_timeout", type=float, default=120.0, help="seconds of actor silence before its state is dropped (0=off)")
-    p.add_argument("--entropy_beta_final", type=float, default=None, help="linear-anneal entropy beta to this over max_epoch (fused trainer)")
-    p.add_argument("--learning_rate_final", type=float, default=None, help="linear-anneal LR to this over max_epoch (fused trainer)")
+    p.add_argument("--entropy_beta_final", type=float, default=None, help="linear-anneal entropy beta to this over max_epoch (ScheduledHyperParamSetter)")
+    p.add_argument("--learning_rate_final", type=float, default=None, help="linear-anneal LR to this over max_epoch (ScheduledHyperParamSetter)")
     p.add_argument("--profiler_port", type=int, default=0, help="start jax.profiler server on this port (0=off)")
     return p
 
@@ -141,6 +141,15 @@ def _build_player_factory(args, cfg: BA3CConfig):
             name=args.env.split(":", 1)[1],
             frame_history=cfg.frame_history,
         )
+    if args.env.startswith("gym:"):
+        from distributed_ba3c_tpu.envs.gym_adapter import build_gym_player
+
+        return functools.partial(
+            build_gym_player,
+            name=args.env.split(":", 1)[1],
+            frame_history=cfg.frame_history,
+            image_size=cfg.image_size,
+        )
     if args.env.startswith("zmq:"):
         # external env server (e.g. the C++ batched Atari server) already
         # speaks the simulator wire protocol — there is no in-process player
@@ -171,6 +180,30 @@ def main(argv: Optional[list] = None) -> int:
     if _plat and "," not in _plat:
         jax.config.update("jax_platforms", _plat)
 
+    # Multi-host bootstrap BEFORE any device is touched (reference: the
+    # ClusterSpec/Server must exist before graph placement, SURVEY.md §3.1).
+    from distributed_ba3c_tpu.parallel.distributed import (
+        initialize_from_flags,
+        is_chief,
+        local_batch_slice,
+        make_global_mesh,
+    )
+
+    if _plat == "cpu" or not _plat:
+        # CPU cross-process collectives need gloo; harmless single-host.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
+    distributed = initialize_from_flags(args.worker_hosts, args.task_index)
+    # base (chief) logdir: shared artifacts — checkpoints (orbax collective
+    # saves need ONE path on every process) and hyper.txt (all hosts must
+    # read the SAME live-hyperparam file or their updates diverge)
+    base_logdir = args.logdir
+    if distributed and not is_chief():
+        # non-chief hosts keep their own log dir (chief owns stat.json)
+        args.logdir = f"{args.logdir}-worker{args.task_index}"
+
     from distributed_ba3c_tpu.models.a3c import BA3CNet
     from distributed_ba3c_tpu.ops.gradproc import make_optimizer
     from distributed_ba3c_tpu.parallel.mesh import make_mesh
@@ -187,8 +220,9 @@ def main(argv: Optional[list] = None) -> int:
     )
 
     if args.profiler_port:
-        jax.profiler.start_server(args.profiler_port)
-        logger.info("jax profiler server on :%d", args.profiler_port)
+        from distributed_ba3c_tpu.utils.profiling import start_server
+
+        start_server(args.profiler_port)
 
     if args.task in ("eval", "play"):
         state = create_train_state(jax.random.PRNGKey(0), model, cfg, optimizer)
@@ -199,7 +233,10 @@ def main(argv: Optional[list] = None) -> int:
 
     state = create_train_state(jax.random.PRNGKey(0), model, cfg, optimizer)
 
-    mesh = make_mesh(num_data=args.mesh_data, num_model=1)
+    if distributed:
+        mesh = make_global_mesh(num_model=1)
+    else:
+        mesh = make_mesh(num_data=args.mesh_data, num_model=1)
 
     from distributed_ba3c_tpu.actors.master import BA3CSimulatorMaster
     from distributed_ba3c_tpu.actors.simulator import (
@@ -216,12 +253,24 @@ def main(argv: Optional[list] = None) -> int:
         MaxSaver,
         ModelSaver,
         PeriodicTrigger,
+        ScheduledHyperParamSetter,
         StartProcOrThread,
         StatPrinter,
     )
     from distributed_ba3c_tpu.train.trainer import Trainer, TrainLoopConfig
 
     build_player = _build_player_factory(args, cfg)
+    # train-mode episode guards (reference get_player(train=True) stacked
+    # PreventStuck + LimitLength around the simulators; eval stays unguarded)
+    from distributed_ba3c_tpu.envs.wrappers import guarded_player
+
+    sim_build_player = functools.partial(
+        guarded_player,
+        base_build=build_player,
+        episode_length_cap=cfg.episode_length_cap,
+        stuck_limit=30,
+        stuck_action=1,
+    )
     predictor = BatchedPredictor(
         model,
         state.params,
@@ -231,6 +280,7 @@ def main(argv: Optional[list] = None) -> int:
     c2s, s2c = default_pipes()
     score_q: queue.Queue = queue.Queue(maxsize=4096)
     n_data = mesh.shape["data"]
+    n_hosts = jax.process_count()
     if args.trainer == "tpu_vtrace_ba3c":
         step = make_vtrace_train_step(model, optimizer, cfg, mesh)
         master = VTraceSimulatorMaster(
@@ -241,10 +291,12 @@ def main(argv: Optional[list] = None) -> int:
             score_queue=score_q,
             actor_timeout=args.actor_timeout or None,
         )
-        # segments per batch: ~batch_size transitions, divisible by data axis
+        # segments per GLOBAL batch: ~batch_size transitions, divisible by
+        # the data axis; each host's feed collates only its 1/n_hosts share
         n_seg = max(1, cfg.batch_size // cfg.local_time_max)
         n_seg = max(n_data, (n_seg // n_data) * n_data)
-        feed = RolloutFeed(master.queue, n_seg)
+        assert n_seg % n_hosts == 0, (n_seg, n_hosts)
+        feed = RolloutFeed(master.queue, n_seg // n_hosts)
         samples_per_step = n_seg * cfg.local_time_max
     else:
         step = make_train_step(model, optimizer, cfg, mesh)
@@ -257,7 +309,9 @@ def main(argv: Optional[list] = None) -> int:
             score_queue=score_q,
             actor_timeout=args.actor_timeout or None,
         )
-        feed = TrainFeed(master.queue, cfg.batch_size)
+        if distributed:
+            local_batch_slice(cfg.batch_size)  # asserts host divisibility
+        feed = TrainFeed(master.queue, cfg.batch_size // n_hosts)
         samples_per_step = cfg.batch_size
     if args.env.startswith("cpp:"):
         # batched native servers: each process hosts up to 16 envs in lockstep
@@ -279,22 +333,48 @@ def main(argv: Optional[list] = None) -> int:
         ]
     else:
         procs = [
-            SimulatorProcess(i, c2s, s2c, build_player)
+            SimulatorProcess(i, c2s, s2c, sim_build_player)
             for i in range(cfg.simulator_procs)
         ]
 
     # Order matters: Evaluator adds its stats BEFORE StatPrinter finalizes the
     # epoch record, and MaxSaver reads last_mean_score set by StatPrinter.
+    chief = is_chief()
     callbacks = [
         StartProcOrThread([predictor, master, feed] + procs),
-        HumanHyperParamSetter("learning_rate"),
-        PeriodicTrigger(
-            Evaluator(args.nr_eval, build_player), every_k_epochs=args.eval_every
-        ),
+        HumanHyperParamSetter("learning_rate", shared_dir=base_logdir),
         StatPrinter(),
-        ModelSaver(),
+        # ONE checkpoint dir for every host: orbax saves are collective and
+        # must target the same path on all processes
+        ModelSaver(ckpt_dir=os.path.join(base_logdir, "checkpoints")),
         MaxSaver(),
     ]
+    if chief:
+        # chief-only eval, matching the reference's chief-worker summary role
+        callbacks.insert(
+            2,
+            PeriodicTrigger(
+                Evaluator(args.nr_eval, build_player),
+                every_k_epochs=args.eval_every,
+            ),
+        )
+    # reference-signature LR/β schedules (SURVEY.md §2.9), CLI-activated
+    if args.learning_rate_final is not None:
+        callbacks.append(
+            ScheduledHyperParamSetter(
+                "learning_rate",
+                [(1, cfg.learning_rate), (args.max_epoch, args.learning_rate_final)],
+                interp="linear",
+            )
+        )
+    if args.entropy_beta_final is not None:
+        callbacks.append(
+            ScheduledHyperParamSetter(
+                "entropy_beta",
+                [(1, cfg.entropy_beta), (args.max_epoch, args.entropy_beta_final)],
+                interp="linear",
+            )
+        )
     from distributed_ba3c_tpu.train.experiment import ExperimentLogger
 
     callbacks.append(ExperimentLogger())
@@ -312,6 +392,7 @@ def main(argv: Optional[list] = None) -> int:
         callbacks,
         predictor=predictor,
         score_queue=score_q,
+        is_chief=chief,
         samples_per_step=samples_per_step,
     )
     if args.load:
@@ -347,6 +428,19 @@ def _run_eval(args, cfg, model, state) -> int:
 
 
 def _run_fused(args, cfg, model, optimizer) -> int:
+    import jax
+
+    if jax.process_count() > 1:
+        # the fused path builds per-host meshes and device_puts host arrays;
+        # multi-process wiring (make_global_mesh + process-local puts) is the
+        # ZMQ trainers' path today — fail loudly instead of crashing deep in
+        # device_put with a non-addressable-sharding error
+        raise SystemExit(
+            "--trainer=tpu_fused_ba3c does not support --worker_hosts yet; "
+            "multi-host training uses --trainer=tpu_sync_ba3c/tpu_vtrace_ba3c "
+            "(the fused trainer scales across the chips of one host via its "
+            "device mesh)"
+        )
     try:
         from distributed_ba3c_tpu.fused.loop import run_fused_training
     except ImportError:

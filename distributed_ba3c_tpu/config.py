@@ -33,6 +33,7 @@ class BA3CConfig:
     local_time_max: int = 5                  # LOCAL_TIME_MAX (n-step truncation)
     entropy_beta: float = 0.01               # entropy bonus coefficient
     value_loss_coef: float = 0.5             # weight on the L2 value loss
+    value_huber_delta: float | None = None   # Huber value loss if set (robust)
     grad_clip_norm: float = 0.5              # global-norm clip [M]
 
     # --- optimizer --------------------------------------------------------

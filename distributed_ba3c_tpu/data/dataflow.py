@@ -91,6 +91,11 @@ class _BatchFeed:
     def stop(self) -> None:
         self._stop.set()
 
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Wait for the batcher thread to exit (it polls with 0.2s timeout)."""
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
     def _loop(self) -> None:
         holder: List = []
         while not self._stop.is_set():

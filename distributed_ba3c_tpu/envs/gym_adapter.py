@@ -53,3 +53,55 @@ class GymEnv(RLEnvironment):
     def restart_episode(self) -> None:
         self._obs, _ = self.gymenv.reset()
         self.score = 0.0
+
+
+def imageize_obs(obs: np.ndarray, image_size: Tuple[int, int] = (84, 84)) -> np.ndarray:
+    """Embed any observation into a uint8 [H, W] frame for the conv net.
+
+    Image observations are grayscaled + resized (the AtariPlayer preproc
+    path); low-dimensional vectors are tanh-squashed into per-feature
+    vertical bands so classic-control envs run through the unchanged
+    BA3C pipeline.
+    """
+    obs = np.asarray(obs)
+    if obs.ndim >= 2:  # image-like
+        import cv2
+
+        if obs.ndim == 3:
+            obs = obs.mean(axis=-1)
+        if np.issubdtype(obs.dtype, np.floating):
+            # normalized float frames ([0,1]) must be rescaled before the
+            # uint8 cast or every pixel truncates to 0/1 (all-black input)
+            if obs.size and obs.max() <= 1.0:
+                obs = obs * 255.0
+            obs = np.clip(obs, 0.0, 255.0)
+        return cv2.resize(obs.astype(np.uint8), image_size[::-1])
+    flat = obs.astype(np.float32).ravel()
+    vals = (np.tanh(flat) * 127.5 + 127.5).astype(np.uint8)
+    img = np.zeros(image_size, np.uint8)
+    w = image_size[1]
+    band = max(1, w // max(1, len(vals)))
+    for i, v in enumerate(vals[: w // band]):
+        img[:, i * band : (i + 1) * band] = v
+    return img
+
+
+def build_gym_player(
+    idx: int,
+    name: str = "CartPole-v1",
+    frame_history: int = 4,
+    image_size: Tuple[int, int] = (84, 84),
+):
+    """Player factory for ``--env gym:<name>`` (top-level: picklable)."""
+    import functools
+
+    from distributed_ba3c_tpu.envs.wrappers import (
+        HistoryFramePlayer,
+        MapPlayerState,
+    )
+
+    env = GymEnv(name, seed=idx)
+    mapped = MapPlayerState(
+        env, functools.partial(imageize_obs, image_size=image_size)
+    )
+    return HistoryFramePlayer(mapped, frame_history)

@@ -2,22 +2,35 @@
 
 Lets the on-device envs (envs/jaxenv/) serve the HOST actor plane too — a
 SimulatorProcess child or the Evaluator can run `jax:pong` through the same
-player protocol as FakeEnv/ALE (envs/base.py). Forces the CPU backend in the
-child: simulator children must never grab the (single) TPU.
+player protocol as FakeEnv/ALE (envs/base.py).
+
+Backend policy (ADVICE r1): simulator CHILDREN force the CPU platform via the
+environment variable before jax is first imported — they must never grab the
+(single) TPU. In the TRAINER process (Evaluator / --task eval) the global
+platform is NEVER mutated; the env's tiny step is merely pinned to a CPU
+device with ``jax.default_device`` so eval cannot flip the trainer's backend
+mid-training.
 """
 
 from __future__ import annotations
 
+import multiprocessing
 import os
 
 import numpy as np
 
 
+def _in_child_process() -> bool:
+    return multiprocessing.parent_process() is not None
+
+
 def build_jax_player(idx: int, name: str = "pong", frame_history: int = 4):
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if _in_child_process() and "jax" not in __import__("sys").modules:
+        # spawned simulator child: safe to force CPU before jax exists
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
-    if jax.default_backend() != "cpu":
+    if _in_child_process() and jax.default_backend() != "cpu":
         try:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
@@ -29,12 +42,20 @@ def build_jax_player(idx: int, name: str = "pong", frame_history: int = 4):
 
     env = get_env(name)
     step = jax.jit(env.step)
+    # pin the per-step computation to CPU WITHOUT touching global config:
+    # one env step is host-scale work; dispatching it to the TPU would
+    # serialize against training for no gain.
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        cpu = None
 
     class _JaxPlayer(RLEnvironment):
         def __init__(self):
-            self.key = jax.random.PRNGKey(idx)
-            self.state = env.reset(self.key)
-            self.obs = np.asarray(env.render(self.state))
+            with jax.default_device(cpu):
+                self.key = jax.random.PRNGKey(idx)
+                self.state = env.reset(self.key)
+                self.obs = np.asarray(env.render(self.state))
             self.score = 0.0
             super().__init__()
 
@@ -45,9 +66,10 @@ def build_jax_player(idx: int, name: str = "pong", frame_history: int = 4):
             return env.num_actions
 
         def action(self, act):
-            self.key, k = jax.random.split(self.key)
-            self.state, obs, r, d = step(self.state, np.int32(act), k)
-            self.obs = np.asarray(obs)
+            with jax.default_device(cpu):
+                self.key, k = jax.random.split(self.key)
+                self.state, obs, r, d = step(self.state, np.int32(act), k)
+                self.obs = np.asarray(obs)
             r, d = float(r), bool(d)
             self.score += r
             if d:
@@ -56,9 +78,10 @@ def build_jax_player(idx: int, name: str = "pong", frame_history: int = 4):
             return r, d
 
         def restart_episode(self):
-            self.key, k = jax.random.split(self.key)
-            self.state = env.reset(k)
-            self.obs = np.asarray(env.render(self.state))
+            with jax.default_device(cpu):
+                self.key, k = jax.random.split(self.key)
+                self.state = env.reset(k)
+                self.obs = np.asarray(env.render(self.state))
             self.score = 0.0
 
     return HistoryFramePlayer(_JaxPlayer(), frame_history)

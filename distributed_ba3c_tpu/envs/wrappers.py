@@ -90,6 +90,28 @@ class PreventStuckPlayer(ProxyPlayer):
         self.last_obs.clear()
 
 
+def guarded_player(
+    idx: int,
+    base_build: Callable[[int], RLEnvironment],
+    episode_length_cap: int = 0,
+    stuck_limit: int = 0,
+    stuck_action: int = 1,
+) -> RLEnvironment:
+    """Apply the reference's train-mode episode guards around a base player.
+
+    Reference ``get_player(train=True)`` stacked PreventStuckPlayer +
+    LimitLengthPlayer outside the history/map wrappers (SURVEY.md §2.2 #6).
+    Top-level function so ``functools.partial`` of it stays picklable for
+    spawned SimulatorProcess children.
+    """
+    p = base_build(idx)
+    if stuck_limit:
+        p = PreventStuckPlayer(p, stuck_limit, stuck_action)
+    if episode_length_cap:
+        p = LimitLengthPlayer(p, episode_length_cap)
+    return p
+
+
 class LimitLengthPlayer(ProxyPlayer):
     """Cap episode length at ``limit`` steps (reference cap: 40000)."""
 

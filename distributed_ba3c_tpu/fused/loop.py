@@ -98,8 +98,14 @@ def make_fused_step(
     mesh: Mesh,
     env,
     rollout_len: int = 20,
+    grad_chunk_samples: int = 24576,
 ) -> Callable:
-    """Build fn(state, entropy_beta, lr) -> (state, metrics), fully on-device."""
+    """Build fn(state, entropy_beta, lr) -> (state, metrics), fully on-device.
+
+    ``grad_chunk_samples`` bounds the per-fwd+bwd batch in the learner (HBM
+    activation cap); 24576 lets the shipped 1024-env × 20-step shape run as
+    ONE flat chunk on a 16 GB v5e.
+    """
 
     def local_step(state: FusedState, entropy_beta, learning_rate):
         params = state.train.params
@@ -117,19 +123,19 @@ def make_fused_step(
             env_state, obs, reward, done = jax.vmap(env.step)(
                 env_state, actions, env_keys
             )
-            new_stack = jnp.concatenate([stack[..., 1:], obs[..., None]], axis=-1)
+            # a done frame must not leak history into the new episode: zero
+            # the carried history via a mask multiply (single fused pass —
+            # cheaper than building a zeroed copy and where-selecting)
+            keep = (~done).astype(stack.dtype)[:, None, None, None]
+            new_stack = jnp.concatenate(
+                [stack[..., 1:] * keep, obs[..., None]], axis=-1
+            )
             # episode bookkeeping (done ⇒ env auto-restarted inside step)
             ep_ret = ep_ret + reward
             donef = done.astype(jnp.float32)
             ep_sum = ep_sum + ep_ret * donef
             ep_cnt = ep_cnt + done.astype(jnp.int32)
             ep_ret = ep_ret * (1.0 - donef)
-            # a done frame must not leak history into the new episode
-            new_stack = jnp.where(
-                done[:, None, None, None],
-                jnp.zeros_like(new_stack).at[..., -1].set(obs),
-                new_stack,
-            )
             ys = (stack, actions, reward, donef)
             return (env_state, new_stack, key, ep_ret, ep_cnt, ep_sum), ys
 
@@ -154,12 +160,12 @@ def make_fused_step(
 
         T, B = actions_t.shape
 
-        # Gradient accumulation over the T axis: one fwd+bwd per [B]-chunk
-        # inside a scan. Differentiating a single [T*B] forward would hold
-        # every conv activation at once (~29 GB at B=1024, T=20 — exceeds
-        # HBM); chunking bounds activation memory at one timestep's batch
-        # while keeping each matmul MXU-sized. Mean-of-chunk-grads equals the
-        # full-batch gradient (equal chunk sizes).
+        # Learner: fwd+bwd over the FLAT [T*B] batch in as few chunks as HBM
+        # allows. Profile-driven (see PERF.md): at B=1024 per-timestep chunks
+        # ran the convs at ~30% MFU (180.7ms) while one flat 20480-sample
+        # fwd+bwd hit ~80% MFU (69.0ms) on a v5e — batch size per matmul is
+        # the whole game. Chunking (equal sizes) only bounds activation
+        # memory; mean-of-chunk-grads equals the full-batch gradient.
         def chunk_grad(p, chunk):
             states_c, actions_c, returns_c = chunk
 
@@ -172,31 +178,51 @@ def make_fused_step(
                     returns_c,
                     entropy_beta=entropy_beta,
                     value_loss_coef=cfg.value_loss_coef,
+                    huber_delta=cfg.value_huber_delta,
                 )
                 return loss.total, loss
 
             return jax.value_and_grad(loss_fn, has_aux=True)(p)
 
-        def acc_body(carry, chunk):
-            g_acc, aux_acc = carry
-            (_, aux), g = chunk_grad(params, chunk)
-            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
-            aux_acc = jax.tree_util.tree_map(jnp.add, aux_acc, aux)
-            return (g_acc, aux_acc), None
+        flat = lambda x: x.reshape(T * B, *x.shape[2:])  # noqa: E731
+        states_f, actions_f, returns_f = (
+            flat(states_t),
+            flat(actions_t),
+            flat(returns_t),
+        )
+        n_chunks = max(1, -(-(T * B) // grad_chunk_samples))
+        while (T * B) % n_chunks:
+            n_chunks += 1
+        if n_chunks == 1:
+            (_, aux), grads = chunk_grad(
+                params, (states_f, actions_f, returns_f)
+            )
+        else:
+            C = (T * B) // n_chunks
+            chunked = lambda x: x.reshape(n_chunks, C, *x.shape[1:])  # noqa: E731
 
-        g0 = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params
-        )
-        (_, aux0), gfirst = chunk_grad(
-            params, (states_t[0], actions_t[0], returns_t[0])
-        )
-        (grads, aux_sum), _ = jax.lax.scan(
-            acc_body,
-            (jax.tree_util.tree_map(jnp.add, g0, gfirst), aux0),
-            (states_t[1:], actions_t[1:], returns_t[1:]),
-        )
-        grads = jax.tree_util.tree_map(lambda g: g / T, grads)
-        aux = jax.tree_util.tree_map(lambda a: a / T, aux_sum)
+            def acc_body(carry, chunk):
+                g_acc, aux_acc = carry
+                (_, aux), g = chunk_grad(params, chunk)
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                aux_acc = jax.tree_util.tree_map(jnp.add, aux_acc, aux)
+                return (g_acc, aux_acc), None
+
+            (_, aux0), g0 = chunk_grad(
+                params,
+                (chunked(states_f)[0], chunked(actions_f)[0], chunked(returns_f)[0]),
+            )
+            (grads, aux_sum), _ = jax.lax.scan(
+                acc_body,
+                (g0, aux0),
+                (
+                    chunked(states_f)[1:],
+                    chunked(actions_f)[1:],
+                    chunked(returns_f)[1:],
+                ),
+            )
+            grads = jax.tree_util.tree_map(lambda g: g / n_chunks, grads)
+            aux = jax.tree_util.tree_map(lambda a: a / n_chunks, aux_sum)
         n_data = jax.lax.axis_size(DATA_AXIS)
         grads = jax.tree_util.tree_map(lambda g: g / n_data, grads)
 
@@ -335,6 +361,21 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
         return v0 + f * (v1 - v0)
 
     best = -np.inf
+    try:
+        _fused_epoch_loop(
+            args, cfg, step, state, holder, ckpt, samples_per_iter,
+            n_envs, sched, best,
+        )
+    finally:
+        holder.close()
+    return 0
+
+
+def _fused_epoch_loop(
+    args, cfg, step, state, holder, ckpt, samples_per_iter, n_envs, sched, best
+):
+    from distributed_ba3c_tpu.utils import logger
+
     for epoch in range(1, args.max_epoch + 1):
         beta = sched(cfg.entropy_beta, args.entropy_beta_final, epoch)
         lr = sched(cfg.learning_rate, args.learning_rate_final, epoch)
@@ -379,4 +420,3 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
         if np.isfinite(mean_ret) and mean_ret > best:
             best = mean_ret
             ckpt.mark_best(int(state.train.step), mean_ret)
-    return 0

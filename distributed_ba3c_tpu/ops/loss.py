@@ -35,6 +35,7 @@ def a3c_loss(
     returns: jax.Array,
     entropy_beta: float | jax.Array = 0.01,
     value_loss_coef: float | jax.Array = 0.5,
+    huber_delta: float | None = None,
 ) -> A3CLossOut:
     """Compute the A3C objective over a flat batch.
 
@@ -46,6 +47,9 @@ def a3c_loss(
       entropy_beta: entropy bonus coefficient (scheduled at runtime, so it may
         be a traced scalar — reference schedules it via HyperParamSetter).
       value_loss_coef: weight on the value L2 term.
+      huber_delta: if set, the value loss is Huber(delta) instead of L2 — the
+        reference's symbolic_functions.huber_loss variant (outlier-robust
+        value regression for high-variance returns).
 
     All statistics are means over the batch, so the loss is invariant to how
     the batch is sharded across devices.
@@ -64,7 +68,12 @@ def a3c_loss(
     advantage = returns - jax.lax.stop_gradient(values)
     policy_loss = -jnp.mean(action_log_probs * advantage)
 
-    value_loss = 0.5 * jnp.mean(jnp.square(values - returns))
+    if huber_delta is not None:
+        from distributed_ba3c_tpu.ops.symbolic import huber_loss
+
+        value_loss = jnp.mean(huber_loss(values - returns, huber_delta))
+    else:
+        value_loss = 0.5 * jnp.mean(jnp.square(values - returns))
 
     entropy = -jnp.mean(jnp.sum(probs * log_probs, axis=-1))
 

@@ -1,8 +1,12 @@
 """Symbolic helper functions.
 
 Reference equivalent: ``tensorpack/tfutils/symbolic_functions.py`` (SURVEY.md
-§2.6 #18) — the grab-bag of loss/metric helpers the model code pulls from
-(huber loss, prediction error counts). Pure jnp functions here.
+§2.6 #18). Only the helper the RL pipeline actually consumes is kept:
+``huber_loss`` backs the optional robust value regression in
+:func:`distributed_ba3c_tpu.ops.loss.a3c_loss` (``huber_delta``). The
+reference file's supervised-learning metrics (accuracy / top-k error) have
+no call sites in an RL framework and were dropped rather than carried as
+dead parity filler.
 """
 
 from __future__ import annotations
@@ -17,16 +21,3 @@ def huber_loss(x: jax.Array, delta: float = 1.0) -> jax.Array:
     quad = 0.5 * jnp.square(x)
     lin = delta * (abs_x - 0.5 * delta)
     return jnp.where(abs_x <= delta, quad, lin)
-
-
-def prediction_incorrect(
-    logits: jax.Array, labels: jax.Array, topk: int = 1
-) -> jax.Array:
-    """1.0 where the label is NOT in the top-k predictions (error vector)."""
-    _, pred = jax.lax.top_k(logits, topk)
-    hit = jnp.any(pred == labels[:, None], axis=-1)
-    return (~hit).astype(jnp.float32)
-
-
-def accuracy(logits: jax.Array, labels: jax.Array, topk: int = 1) -> jax.Array:
-    return 1.0 - jnp.mean(prediction_incorrect(logits, labels, topk))

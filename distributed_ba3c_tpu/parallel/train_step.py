@@ -85,6 +85,7 @@ def _local_step(
             batch["return"],
             entropy_beta=entropy_beta,
             value_loss_coef=cfg.value_loss_coef,
+            huber_delta=cfg.value_huber_delta,
         )
         return loss.total, loss
 

@@ -94,6 +94,12 @@ class BatchedPredictor:
         for t in self.threads:
             t.stop()
 
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Wait for worker threads to exit (they poll with 0.5s timeout)."""
+        for t in self.threads:
+            if t.is_alive():
+                t.join(timeout)
+
     # -- API ---------------------------------------------------------------
     def update_params(self, params) -> None:
         """Publish fresh weights (atomic ref swap; next batch uses them)."""

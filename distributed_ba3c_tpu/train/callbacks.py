@@ -147,12 +147,40 @@ class StartProcOrThread(Callback):
         )
 
     def after_train(self) -> None:
-        for s in self.startables:
+        """Full teardown, not just a stop signal: join every thread and
+        reap every process so nothing outlives the trainer. Leaked ZMQ /
+        predictor threads wedge later in-process jit dispatch (the round-1
+        pytest deadlock), so stop → join → close → reap, in that order.
+        """
+        import multiprocessing as mp
+
+        procs = [s for s in self.startables if isinstance(s, mp.process.BaseProcess)]
+        others = [s for s in self.startables if not isinstance(s, mp.process.BaseProcess)]
+        # 1. signal everything to stop (cheap, non-blocking)
+        for s in others:
             stop = getattr(s, "stop", None)
             if callable(stop):
                 stop()
-            elif hasattr(s, "terminate"):
-                s.terminate()
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        # 2. join threads/servers, then close (tears down ZMQ contexts etc.)
+        for s in others:
+            join = getattr(s, "join", None)
+            if callable(join):
+                try:
+                    join(timeout=5)
+                except TypeError:
+                    join()
+            close = getattr(s, "close", None)
+            if callable(close):
+                close()
+        # 3. reap children
+        for p in procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=5)
 
 
 class HyperParamSetter(Callback):
@@ -225,12 +253,21 @@ class HumanHyperParamSetter(HyperParamSetter):
     The reference's human-editable live hyperparam file (SURVEY.md §2.7 #21).
     """
 
-    def __init__(self, name: str, fname: str = "hyper.txt"):
+    def __init__(
+        self,
+        name: str,
+        fname: str = "hyper.txt",
+        shared_dir: Optional[str] = None,
+    ):
+        """``shared_dir``: where to look for the file — in multi-host runs
+        pass the CHIEF's logdir so every host reads the SAME file (per-host
+        files would silently diverge the psum'd update)."""
         super().__init__(name)
         self.fname = fname
+        self.shared_dir = shared_dir
 
     def _value_to_set(self) -> Optional[float]:
-        log_dir = self.trainer.config.log_dir
+        log_dir = self.shared_dir or self.trainer.config.log_dir
         if log_dir is None:
             return None
         path = os.path.join(log_dir, self.fname)
@@ -320,15 +357,19 @@ class ModelSaver(Callback):
         d = self.ckpt_dir or os.path.join(
             self.trainer.config.log_dir or ".", "checkpoints"
         )
-        if self.trainer.is_chief:
-            self.trainer.ckpt_manager = CheckpointManager(d)
+        # EVERY process gets a manager pointed at the SAME directory: orbax
+        # saves are collective in multi-process runs (chief-only saving
+        # deadlocks the chief in orbax's barrier). Metadata/pruning are
+        # chief-only inside CheckpointManager.
+        self.trainer.ckpt_manager = CheckpointManager(d)
 
     def trigger_epoch(self):
         if self.trainer.ckpt_manager is not None:
             path = self.trainer.ckpt_manager.save(
                 self.trainer.state, self.trainer.global_step
             )
-            logger.info("saved checkpoint %s", path)
+            if self.trainer.is_chief:
+                logger.info("saved checkpoint %s", path)
 
 
 class MaxSaver(Callback):

@@ -41,6 +41,10 @@ class CheckpointManager:
         return os.path.join(self.root, f"ckpt-{step}")
 
     def save(self, state: Any, step: int) -> str:
+        """Save. In multi-process runs EVERY process must call this with the
+        same path: orbax synchronizes all processes on save (a chief-only
+        call deadlocks the chief in the barrier — seen in the 2-process CLI
+        test). Metadata and pruning stay chief-only below."""
         path = self._dir(step)
         self._ckpt.save(path, jax.device_get(state), force=True)
         # StandardCheckpointer is async in this orbax version; commit before
@@ -48,6 +52,8 @@ class CheckpointManager:
         wait = getattr(self._ckpt, "wait_until_finished", None)
         if callable(wait):
             wait()
+        if jax.process_index() != 0:
+            return path
         self._meta["all"].append(step)
         self._meta["latest"] = step
         # prune oldest beyond max_to_keep; NEVER delete the best or the
@@ -74,7 +80,8 @@ class CheckpointManager:
         if best is None or score > best:
             self._meta["best"] = step
             self._meta["best_score"] = float(score)
-            self._write_meta()
+            if jax.process_index() == 0:
+                self._write_meta()
             return True
         return False
 

@@ -110,13 +110,25 @@ class Trainer:
                 return
 
     # -- loop --------------------------------------------------------------
+    def _put(self, v, sharding):
+        """Host batch → sharded device array.
+
+        Single-host: plain device_put. Multi-host: each process feeds its
+        LOCAL rows and jax assembles the global array from per-host shards
+        (the replacement for the reference's per-worker queue; each TF
+        worker likewise only saw its own simulators' batches, SURVEY §3.4).
+        """
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sharding, v)
+        return jax.device_put(v, sharding)
+
     def run_step(self) -> None:
         batch = self.feed.next_batch(timeout=self.config.feed_timeout)
         sharding = self.step_fn.batch_sharding
         if isinstance(sharding, dict):
-            batch = {k: jax.device_put(v, sharding[k]) for k, v in batch.items()}
+            batch = {k: self._put(v, sharding[k]) for k, v in batch.items()}
         else:
-            batch = {k: jax.device_put(v, sharding) for k, v in batch.items()}
+            batch = {k: self._put(v, sharding) for k, v in batch.items()}
         self.state, self.metrics = self.step_fn(
             self.state,
             batch,
@@ -152,6 +164,9 @@ class Trainer:
             raise RuntimeError("train feed starved; actor plane dead") from None
         finally:
             self._callbacks.after_train()
+            # close the TB event writer (a never-joined background thread
+            # otherwise — the exact leak class behind the round-1 deadlock)
+            self.stat_holder.close()
 
     # -- resume ------------------------------------------------------------
     def restore(self, ckpt_dir: str, step: Optional[int] = None) -> None:

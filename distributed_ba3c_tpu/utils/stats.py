@@ -56,11 +56,16 @@ class StatHolder:
     unchanged.
     """
 
-    def __init__(self, log_dir: Optional[str] = None):
+    def __init__(self, log_dir: Optional[str] = None, tensorboard: bool = True):
         self.log_dir = log_dir
         self.stat_now: Dict[str, float] = {}
         self.stat_history: List[Dict[str, float]] = []
         self._print_filter = None
+        self._tb = None
+        if log_dir is not None and tensorboard:
+            from distributed_ba3c_tpu.utils.tb_writer import TBScalarWriter
+
+            self._tb = TBScalarWriter(log_dir)
         if log_dir is not None:
             os.makedirs(log_dir, exist_ok=True)
             self._path = os.path.join(log_dir, "stat.json")
@@ -77,7 +82,7 @@ class StatHolder:
         self.stat_now[name] = float(value)
 
     def finalize(self) -> Dict[str, float]:
-        """Close the epoch: append the record, write stat.json, reset."""
+        """Close the epoch: append the record, write stat.json + TB events."""
         record = dict(self.stat_now)
         self.stat_history.append(record)
         if self._path is not None:
@@ -85,5 +90,13 @@ class StatHolder:
             with open(tmp, "w") as f:
                 json.dump(self.stat_history, f)
             os.replace(tmp, self._path)
+        if self._tb is not None:
+            step = int(record.get("global_step", record.get("epoch", 0)))
+            self._tb.add_scalars(record, step)
+            self._tb.flush()
         self.stat_now = {}
         return record
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()

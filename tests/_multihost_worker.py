@@ -1,0 +1,134 @@
+"""Subprocess body for the 2-process jax.distributed integration test.
+
+Run as:  python _multihost_worker.py <rank> <nprocs> <coordinator> [cli]
+
+Bootstraps jax.distributed over localhost TCP (gloo CPU collectives), builds
+the host-major global mesh, runs ONE sharded train step where each process
+feeds a DIFFERENT local batch shard, and prints the resulting param digest.
+Both ranks must print the identical digest (the psum makes the update global),
+and it must match a single-process run over the concatenated batch — asserted
+by the parent test.
+
+With the optional ``cli`` argument it instead runs the full CLI entry
+(`--worker_hosts` wiring) on FakeEnv for a short run, exercising
+initialize_from_flags/make_global_mesh/is_chief end-to-end.
+"""
+
+import os
+import sys
+
+# NOTE: every side effect lives under __main__ — multiprocessing(spawn)
+# children re-import this module and must NOT re-run jax.distributed.init.
+
+os.environ.setdefault("XLA_FLAGS", "")
+
+import numpy as np  # noqa: E402
+
+
+def param_digest(params) -> str:
+    import jax
+    leaves = jax.tree_util.tree_leaves(jax.device_get(params))
+    return " ".join(f"{np.float64(np.sum(l)):.10e}" for l in leaves)
+
+
+def make_batch(global_batch: int, cfg):
+    """Deterministic global batch; every rank builds the SAME one."""
+    rng = np.random.default_rng(42)
+    return {
+        "state": rng.integers(
+            0, 255, (global_batch, *cfg.state_shape), dtype=np.uint8
+        ),
+        "action": rng.integers(
+            0, cfg.num_actions, (global_batch,), dtype=np.int32
+        ),
+        "return": rng.normal(size=(global_batch,)).astype(np.float32),
+    }
+
+
+def run_step_mode(rank: int, nprocs: int, coordinator: str) -> None:
+    import jax
+
+    from distributed_ba3c_tpu.config import BA3CConfig
+    from distributed_ba3c_tpu.models.a3c import BA3CNet
+    from distributed_ba3c_tpu.ops.gradproc import make_optimizer
+    from distributed_ba3c_tpu.parallel.distributed import (
+        initialize_from_flags,
+        local_batch_slice,
+        make_global_mesh,
+    )
+    from distributed_ba3c_tpu.parallel.train_step import (
+        create_train_state,
+        make_train_step,
+    )
+
+    if nprocs > 1:
+        hosts = ",".join([coordinator] + ["x:0"] * (nprocs - 1))
+        assert initialize_from_flags(hosts, rank)
+        assert jax.process_count() == nprocs
+
+    cfg = BA3CConfig(image_size=(16, 16), fc_units=16, batch_size=8)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    opt = make_optimizer(cfg.learning_rate, cfg.adam_epsilon, cfg.grad_clip_norm)
+    state = create_train_state(jax.random.PRNGKey(0), model, cfg, opt)
+    mesh = make_global_mesh(num_model=1)
+    step = make_train_step(model, opt, cfg, mesh)
+
+    batch = make_batch(cfg.batch_size, cfg)
+    if nprocs > 1:
+        sl = local_batch_slice(cfg.batch_size)
+        local = {k: v[sl] for k, v in batch.items()}
+        put = lambda v: jax.make_array_from_process_local_data(  # noqa: E731
+            step.batch_sharding, v
+        )
+    else:
+        local = batch
+        put = lambda v: jax.device_put(v, step.batch_sharding)  # noqa: E731
+
+    state = jax.device_put(state, step.state_sharding)
+    dbatch = {k: put(v) for k, v in local.items()}
+    new_state, metrics = step(state, dbatch, cfg.entropy_beta)
+    jax.block_until_ready(new_state)
+    print(f"DIGEST {param_digest(new_state.params)}", flush=True)
+    print(f"LOSS {float(metrics['loss']):.10e}", flush=True)
+
+
+def run_cli_mode(rank: int, nprocs: int, coordinator: str, logdir: str) -> None:
+    from distributed_ba3c_tpu.cli import main
+
+    hosts = ",".join(
+        [coordinator] + [f"x{i}:0" for i in range(1, nprocs)]
+    )
+    rc = main(
+        [
+            "--env", "fake",
+            "--worker_hosts", hosts,
+            "--task_index", str(rank),
+            "--simulator_procs", "2",
+            "--batch_size", "16",
+            "--image_size", "16",
+            "--fc_units", "16",
+            "--steps_per_epoch", "20",
+            "--max_epoch", "1",
+            "--nr_eval", "2",
+            "--logdir", logdir,
+        ]
+    )
+    print(f"CLI_RC {rc}", flush=True)
+
+
+if __name__ == "__main__":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    rank = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    coordinator = sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "step"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    if mode == "cli":
+        run_cli_mode(rank, nprocs, coordinator, sys.argv[5])
+    else:
+        run_step_mode(rank, nprocs, coordinator)

@@ -135,5 +135,6 @@ def test_zmq_actor_plane_end_to_end(tmp_path):
             p.terminate()
         master.close()
         predictor.stop()
+        predictor.join(timeout=5)
         for p in procs:
             p.join(timeout=5)

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from distributed_ba3c_tpu.ops.symbolic import accuracy, huber_loss, prediction_incorrect
+from distributed_ba3c_tpu.ops.symbolic import huber_loss
 
 
 def test_huber_loss_regions():
@@ -19,12 +19,30 @@ def test_huber_loss_regions():
     )
 
 
-def test_prediction_incorrect_and_accuracy():
-    logits = jnp.array([[1.0, 2.0, 0.0], [5.0, 1.0, 0.0]])
-    labels = jnp.array([1, 2])
-    err = prediction_incorrect(logits, labels)
-    np.testing.assert_array_equal(np.asarray(err), [0.0, 1.0])
-    assert float(accuracy(logits, labels)) == pytest.approx(0.5)
+def test_huber_value_loss_in_a3c_loss():
+    """huber_delta routes the value loss through Huber (wired, not filler)."""
+    from distributed_ba3c_tpu.ops.loss import a3c_loss
+
+    logits = jnp.zeros((4, 3))
+    values = jnp.array([0.0, 0.0, 0.0, 0.0])
+    actions = jnp.zeros(4, jnp.int32)
+    returns = jnp.array([10.0, 10.0, 10.0, 10.0])  # large residual -> linear
+    l2 = a3c_loss(logits, values, actions, returns)
+    hub = a3c_loss(logits, values, actions, returns, huber_delta=1.0)
+    assert float(hub.value_loss) == pytest.approx(9.5)  # delta*(|x|-delta/2)
+    assert float(l2.value_loss) == pytest.approx(50.0)
+
+
+def test_gym_player_factory_imageizes():
+    """--env gym:<name> route: vector obs become stacked uint8 frames."""
+    pytest.importorskip("gymnasium")
+    from distributed_ba3c_tpu.envs.gym_adapter import build_gym_player
+
+    p = build_gym_player(0, "CartPole-v1", frame_history=4, image_size=(84, 84))
+    s = p.current_state()
+    assert s.shape == (84, 84, 4) and s.dtype == np.uint8
+    r, over = p.action(0)
+    assert isinstance(r, float) and isinstance(over, bool)
 
 
 def test_gym_adapter_cartpole():

@@ -1,0 +1,110 @@
+"""Multi-host integration: 2 real processes over localhost TCP (gloo CPU).
+
+The reference validated its ClusterSpec/PS wiring only on a live cluster
+(SURVEY.md §4); here two subprocesses run `jax.distributed.initialize`,
+feed DIFFERENT local batch shards into the sharded train step, and must
+produce the IDENTICAL post-update params — equal to a single-process run
+over the concatenated batch (the psum makes the update global).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _clean_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(_WORKER))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    # each process gets exactly one CPU device: drop any forced device count
+    env["XLA_FLAGS"] = " ".join(
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    return env
+
+
+def _launch(rank: int, nprocs: int, coord: str, *extra: str) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, _WORKER, str(rank), str(nprocs), coord, *extra],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=_clean_env(),
+        cwd=os.path.dirname(os.path.dirname(_WORKER)),
+    )
+
+
+def _run_pair(*extra: str, timeout: int = 240) -> list:
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = [_launch(r, 2, coord, *extra) for r in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+            assert p.returncode == 0, out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+def _grep(out: str, tag: str) -> str:
+    lines = [l for l in out.splitlines() if l.startswith(tag + " ")]
+    assert lines, f"no {tag!r} line in:\n{out}"
+    return lines[-1][len(tag) + 1 :]
+
+
+@pytest.mark.slow
+def test_two_process_psum_update_identical_and_matches_single():
+    outs = _run_pair()
+    d0, d1 = (_grep(o, "DIGEST") for o in outs)
+    assert d0 == d1, "workers diverged after one psum'd update"
+    l0, l1 = (_grep(o, "LOSS") for o in outs)
+    assert l0 == l1
+
+    # single-process ground truth over the same (concatenated) global batch
+    coord = f"127.0.0.1:{_free_port()}"
+    p = _launch(0, 1, coord)
+    out, _ = p.communicate(timeout=240)
+    assert p.returncode == 0, out
+    d_single = _grep(out, "DIGEST")
+    l_single = _grep(out, "LOSS")
+    import numpy as np
+
+    # loss is computed BEFORE the update on the identical global batch: must
+    # agree to bf16-reduction tolerance between 1-proc and 2-proc runs
+    np.testing.assert_allclose(float(l0), float(l_single), rtol=1e-3)
+    # params after one ADAM step: first-step updates are ±lr·m̂/(√v̂+ε) ≈ ±lr,
+    # so a bf16 ULP difference in a near-zero gradient element flips a whole
+    # ±1e-4 update. Require agreement at Adam-step scale, not float ULPs.
+    a = np.array([float(x) for x in d0.split()])
+    b = np.array([float(x) for x in d_single.split()])
+    np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.slow
+def test_two_process_cli_fake_env_trains(tmp_path):
+    logdir = str(tmp_path / "log")
+    outs = _run_pair("cli", logdir, timeout=420)
+    for out in outs:
+        assert _grep(out, "CLI_RC") == "0"
+    # chief owns stat.json + checkpoints; worker logs to its own dir
+    assert os.path.isfile(os.path.join(logdir, "stat.json")), outs[0]
+    assert os.path.isdir(os.path.join(logdir, "checkpoints")), outs[0]
+    assert not os.path.isdir(os.path.join(logdir + "-worker1", "checkpoints"))

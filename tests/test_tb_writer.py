@@ -1,0 +1,53 @@
+"""TensorBoard scalar plane: events written by StatHolder are readable back."""
+
+import glob
+import os
+
+import pytest
+
+
+def _read_scalars(log_dir):
+    """Parse tfevents files back into {tag: [(step, value)]}."""
+    tbrl = pytest.importorskip("tensorboard.backend.event_processing.event_accumulator")
+    files = glob.glob(os.path.join(log_dir, "events.out.tfevents.*"))
+    assert files, f"no event files in {log_dir}"
+    acc = tbrl.EventAccumulator(log_dir)
+    acc.Reload()
+    return {
+        tag: [(s.step, s.value) for s in acc.Scalars(tag)]
+        for tag in acc.Tags()["scalars"]
+    }
+
+
+def test_stat_holder_emits_tb_events(tmp_path):
+    from distributed_ba3c_tpu.utils.stats import StatHolder
+
+    holder = StatHolder(str(tmp_path))
+    holder.add_stat("mean_score", 12.5)
+    holder.add_stat("loss", 0.25)
+    holder.add_stat("global_step", 100)
+    holder.finalize()
+    holder.add_stat("mean_score", 15.0)
+    holder.add_stat("global_step", 200)
+    holder.finalize()
+    holder.close()
+
+    scalars = _read_scalars(str(tmp_path))
+    assert scalars["mean_score"] == [(100, 12.5), (200, 15.0)]
+    assert scalars["loss"] == [(100, 0.25)]
+    # stat.json still written alongside (same metric names)
+    import json
+
+    stats = json.load(open(tmp_path / "stat.json"))
+    assert stats[0]["mean_score"] == 12.5
+
+
+def test_tb_writer_direct(tmp_path):
+    from distributed_ba3c_tpu.utils.tb_writer import TBScalarWriter
+
+    w = TBScalarWriter(str(tmp_path))
+    for i in range(5):
+        w.add_scalar("fps", 1000.0 + i, i)
+    w.close()
+    scalars = _read_scalars(str(tmp_path))
+    assert [v for _, v in scalars["fps"]] == [1000.0, 1001.0, 1002.0, 1003.0, 1004.0]
