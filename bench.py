@@ -31,7 +31,7 @@ import jax
 BASELINE_ENV_STEPS_PER_SEC = 80_000.0  # recalled 64-node cluster rate, UNVERIFIED
 
 
-def bench_fused(n_envs: int = 1024, rollout_len: int = 20, iters: int = 20) -> dict:
+def bench_fused(n_envs: int = 4096, rollout_len: int = 20, iters: int = 20) -> dict:
     from distributed_ba3c_tpu.config import BA3CConfig
     from distributed_ba3c_tpu.envs.jaxenv import pong
     from distributed_ba3c_tpu.fused.loop import create_fused_state, make_fused_step
@@ -74,8 +74,97 @@ def bench_fused(n_envs: int = 1024, rollout_len: int = 20, iters: int = 20) -> d
     }
 
 
+def bench_zmq_plane(
+    game: str = "pong", n_envs: int = 256, seconds: float = 20.0
+) -> dict:
+    """Actor-plane throughput (BASELINE configs #1/#2): C++ batched env
+    servers -> ZMQ -> master -> batched TPU predictor, counting n-step
+    datapoints entering the train queue. Run via `python bench.py --plane zmq`
+    (the driver's default invocation stays the fused line)."""
+    import queue
+    import tempfile
+
+    import numpy as np
+
+    from distributed_ba3c_tpu.actors.master import BA3CSimulatorMaster
+    from distributed_ba3c_tpu.config import BA3CConfig
+    from distributed_ba3c_tpu.envs import native
+    from distributed_ba3c_tpu.models.a3c import BA3CNet
+    from distributed_ba3c_tpu.predict.server import BatchedPredictor
+
+    cfg = BA3CConfig(num_actions=6, predict_batch_size=256)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, *cfg.state_shape), np.uint8)
+    )["params"]
+    # 2 worker threads (measured best on the tunneled dev chip: more threads
+    # fragment batches without overlapping the serialized link)
+    predictor = BatchedPredictor(
+        model, params, batch_size=cfg.predict_batch_size, num_threads=2,
+        coalesce_ms=5.0,
+    )
+    predictor.warmup(cfg.state_shape)
+    tmp = tempfile.mkdtemp(prefix="ba3c-bench-")
+    c2s, s2c = f"ipc://{tmp}/c2s", f"ipc://{tmp}/s2c"
+    master = BA3CSimulatorMaster(
+        c2s, s2c, predictor,
+        gamma=cfg.gamma, local_time_max=cfg.local_time_max,
+        score_queue=queue.Queue(maxsize=100_000),
+    )
+    per = 32
+    procs = [
+        native.CppEnvServerProcess(
+            i, c2s, s2c, game=game, n_envs=min(per, n_envs - i * per)
+        )
+        for i in range((n_envs + per - 1) // per)
+    ]
+    predictor.start()
+    master.start()
+    for p in procs:
+        p.start()
+    try:
+        # warmup until the pipeline flows, then count datapoints for `seconds`
+        for _ in range(512):
+            master.queue.get(timeout=60)
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < seconds:
+            master.queue.get(timeout=60)
+            n += 1
+        dt = time.perf_counter() - t0
+    finally:
+        for p in procs:
+            p.terminate()
+        master.close()
+        predictor.stop()
+        predictor.join(timeout=5)
+        for p in procs:
+            p.join(timeout=5)
+    rate = n / dt
+    return {
+        "metric": f"zmq_plane_{game}_env_steps_per_sec_per_host",
+        "value": round(rate, 1),
+        "unit": "env-steps/sec/host",
+        "vs_baseline": round(rate / BASELINE_ENV_STEPS_PER_SEC, 3),
+    }
+
+
 def main():
-    print(json.dumps(bench_fused()))
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--plane",
+        choices=["fused", "zmq"],
+        default="fused",
+        help="fused = on-device actor+learner (the driver metric); "
+        "zmq = host actor plane via C++ env servers",
+    )
+    args = ap.parse_args()
+    if args.plane == "zmq":
+        print(json.dumps(bench_zmq_plane()))
+    else:
+        print(json.dumps(bench_fused()))
 
 
 if __name__ == "__main__":

@@ -43,6 +43,32 @@ constexpr int kWinScore = 21;
 constexpr int kNumActions = 6;
 }  // namespace pong
 
+// ------------------------------------------------------------ Seaquest ----
+// Mirrors distributed_ba3c_tpu/envs/jaxenv/seaquest.py: 4 enemy lanes,
+// horizontal torpedoes, oxygen meter with surfacing, 3 lives.
+namespace sq {
+constexpr int kLanes = 4;
+constexpr float kLaneY[kLanes] = {0.35f, 0.5f, 0.65f, 0.8f};
+constexpr float kSurfaceY = 0.15f;
+constexpr float kSubSpeed = 0.03f, kFishSpeed = 0.02f, kTorpSpeed = 0.08f;
+constexpr float kSubR = 0.03f, kFishR = 0.025f;
+constexpr float kOxyMax = 200.f, kOxyRefill = 8.f;
+constexpr int kLives = 3;
+constexpr float kFishPoints = 20.f;
+constexpr int kMaxT = 5000;
+constexpr int kNumActions = 6;
+}  // namespace sq
+
+// --------------------------------------------------------------- Q*bert ---
+// Mirrors distributed_ba3c_tpu/envs/jaxenv/qbert.py: 21-cube pyramid,
+// +25/new cube, +100/board clear, bouncing enemy ball, 3 lives, 1 hop/step.
+namespace qb {
+constexpr int kRows = 6;
+constexpr int kCubes = kRows * (kRows + 1) / 2;  // 21
+constexpr float kCubePoints = 25.f, kClearBonus = 100.f;
+constexpr int kLives = 3, kMaxT = 2000, kNumActions = 5;
+}  // namespace qb
+
 // ------------------------------------------------------------ Breakout ----
 namespace brk {
 constexpr int kRows = 6, kCols = 18;
@@ -304,6 +330,262 @@ class BreakoutEnv : public Env {
   bool in_play_;
 };
 
+// jax-parity rasterizer: pixel-center inequality |Xc-cx|<=hw (matches the
+// jnp renders in envs/jaxenv/, which DrawRect's floor/ceil does not)
+inline void MaxRect(uint8_t* obs, float cx, float cy, float hw, float hh,
+                    uint8_t v) {
+  for (int y = 0; y < kH; ++y) {
+    float Yc = (y + 0.5f) / kH;
+    if (std::fabs(Yc - cy) > hh) continue;
+    for (int x = 0; x < kW; ++x) {
+      float Xc = (x + 0.5f) / kW;
+      if (std::fabs(Xc - cx) <= hw)
+        obs[y * kW + x] = std::max(obs[y * kW + x], v);
+    }
+  }
+}
+
+class SeaquestEnv : public Env {
+ public:
+  explicit SeaquestEnv(uint64_t seed) : rng_(seed) { Reset(); }
+
+  void Reset() override {
+    sub_x_ = sub_y_ = 0.5f;
+    std::uniform_real_distribution<float> uni(0.f, 1.f);
+    for (int i = 0; i < sq::kLanes; ++i) {
+      fish_x_[i] = uni(rng_);
+      fish_dir_[i] = uni(rng_) < 0.5f ? 1.f : -1.f;
+      fish_alive_[i] = true;
+    }
+    torp_x_ = torp_y_ = 0.f;
+    torp_dir_ = 1.f;
+    torp_live_ = false;
+    facing_ = 1.f;
+    oxygen_ = sq::kOxyMax;
+    lives_ = sq::kLives;
+    t_ = 0;
+  }
+
+  StepOut Step(int action) override {
+    StepOut out;
+    for (int i = 0; i < kFrameSkip; ++i) out.reward += Substep(action);
+    ++t_;
+    if (lives_ <= 0 || t_ >= sq::kMaxT) {
+      out.done = true;
+      Reset();
+    }
+    return out;
+  }
+
+  void Render(uint8_t* obs) const override {
+    namespace S = sq;
+    std::memset(obs, 0, kH * kW);
+    for (int y = 0; y < kH; ++y) {  // surface line
+      float Yc = (y + 0.5f) / kH;
+      if (std::fabs(Yc - S::kSurfaceY) < 0.012f)
+        for (int x = 0; x < kW; ++x)
+          obs[y * kW + x] = std::max<uint8_t>(obs[y * kW + x], 80);
+    }
+    float frac = std::clamp(oxygen_ / S::kOxyMax, 0.f, 1.f);
+    for (int y = 0; y < kH; ++y) {  // oxygen bar
+      float Yc = (y + 0.5f) / kH;
+      if (Yc >= 0.04f) continue;
+      for (int x = 0; x < kW; ++x)
+        if ((x + 0.5f) / kW < frac)
+          obs[y * kW + x] = std::max<uint8_t>(obs[y * kW + x], 140);
+    }
+    for (int i = 0; i < S::kLanes; ++i)
+      if (fish_alive_[i])
+        MaxRect(obs, fish_x_[i], S::kLaneY[i], S::kFishR, S::kFishR, 180);
+    if (torp_live_) MaxRect(obs, torp_x_, torp_y_, 0.015f, 0.008f, 220);
+    MaxRect(obs, sub_x_, sub_y_, S::kSubR, S::kSubR, 255);
+  }
+
+  int NumActions() const override { return sq::kNumActions; }
+
+ private:
+  float Substep(int action) {
+    namespace S = sq;
+    // actions: 0 noop, 1 fire, 2 up, 3 down, 4 left, 5 right
+    float dx = (action == 5 ? 1.f : 0.f) - (action == 4 ? 1.f : 0.f);
+    float dy = (action == 3 ? 1.f : 0.f) - (action == 2 ? 1.f : 0.f);
+    bool fire = action == 1;
+    if (dx != 0.f) facing_ = dx > 0 ? 1.f : -1.f;
+    sub_x_ = std::clamp(sub_x_ + dx * S::kSubSpeed, 0.05f, 0.95f);
+    sub_y_ = std::clamp(sub_y_ + dy * S::kSubSpeed, 0.08f, 0.92f);
+
+    // fish advance; off-screen wraparound respawns (alive again)
+    for (int i = 0; i < S::kLanes; ++i) {
+      fish_x_[i] += fish_dir_[i] * S::kFishSpeed;
+      if (fish_x_[i] < -0.05f || fish_x_[i] > 1.05f) {
+        fish_x_[i] = fish_dir_[i] > 0 ? -0.05f : 1.05f;
+        fish_alive_[i] = true;
+      }
+    }
+
+    // torpedo (ordering mirrors seaquest.py _substep)
+    bool was_live = torp_live_;
+    bool live_new = torp_live_ || fire;
+    if (was_live) {
+      torp_x_ += torp_dir_ * S::kTorpSpeed;
+    } else if (fire) {
+      torp_x_ = sub_x_;
+      torp_y_ = sub_y_;
+    }
+    if (!was_live) torp_dir_ = facing_;
+    torp_live_ = live_new && torp_x_ > 0.f && torp_x_ < 1.f;
+
+    float reward = 0.f;
+    bool any_hit = false;
+    for (int i = 0; i < S::kLanes; ++i) {
+      bool hit = fish_alive_[i] && torp_live_ &&
+                 std::fabs(fish_x_[i] - torp_x_) < S::kFishR + 0.02f &&
+                 std::fabs(S::kLaneY[i] - torp_y_) < 0.04f;
+      if (hit) {
+        reward += S::kFishPoints;
+        fish_alive_[i] = false;
+        any_hit = true;
+      }
+    }
+    if (any_hit) torp_live_ = false;
+
+    bool collide = false;
+    for (int i = 0; i < S::kLanes; ++i)
+      collide = collide ||
+                (fish_alive_[i] &&
+                 std::fabs(fish_x_[i] - sub_x_) < S::kFishR + S::kSubR &&
+                 std::fabs(S::kLaneY[i] - sub_y_) < S::kFishR + S::kSubR);
+
+    bool surfaced = sub_y_ <= S::kSurfaceY;
+    oxygen_ = surfaced ? std::min(oxygen_ + S::kOxyRefill, S::kOxyMax)
+                       : oxygen_ - 1.f;
+    bool suffocate = oxygen_ <= 0.f;
+
+    if (collide || suffocate) {
+      --lives_;
+      sub_x_ = sub_y_ = 0.5f;
+      oxygen_ = S::kOxyMax;
+    }
+    return reward;
+  }
+
+  std::mt19937_64 rng_;
+  float sub_x_, sub_y_;
+  float fish_x_[sq::kLanes], fish_dir_[sq::kLanes];
+  bool fish_alive_[sq::kLanes];
+  float torp_x_, torp_y_, torp_dir_;
+  bool torp_live_;
+  float facing_, oxygen_;
+  int lives_, t_;
+};
+
+class QbertEnv : public Env {
+ public:
+  explicit QbertEnv(uint64_t seed) : rng_(seed) { Reset(); }
+
+  void Reset() override {
+    pos_r_ = pos_c_ = 0;
+    std::fill(std::begin(flipped_), std::end(flipped_), false);
+    ball_r_ = 1;
+    ball_c_ = 0;
+    ball_live_ = false;
+    lives_ = qb::kLives;
+    boards_ = 0;
+    t_ = 0;
+  }
+
+  StepOut Step(int action) override {  // FRAME_SKIP=1: the hop IS the quantum
+    namespace Q = qb;
+    StepOut out;
+    // hop: 1 up-right (-1,0), 2 down-right (+1,+1), 3 down-left (+1,0),
+    // 4 up-left (-1,-1)
+    int dr = (action == 2 || action == 3) ? 1 : (action == 1 || action == 4) ? -1 : 0;
+    int dc = action == 2 ? 1 : action == 4 ? -1 : 0;
+    bool moved = action != 0;
+    int nr = pos_r_ + dr, nc = pos_c_ + dc;
+    bool on_board = nr >= 0 && nr < Q::kRows && nc >= 0 && nc <= nr;
+    bool fell = moved && !on_board;
+    if (on_board) {
+      pos_r_ = nr;
+      pos_c_ = nc;
+    }
+
+    int idx = pos_r_ * (pos_r_ + 1) / 2 + pos_c_;
+    bool newly = moved && on_board && !flipped_[idx];
+    if (moved && on_board) flipped_[idx] = true;
+    if (newly) out.reward += Q::kCubePoints;
+
+    bool cleared = true;
+    for (bool f : flipped_) cleared = cleared && f;
+    if (cleared) {
+      out.reward += Q::kClearBonus;
+      std::fill(std::begin(flipped_), std::end(flipped_), false);
+      ++boards_;
+    }
+
+    // enemy ball (mirrors qbert.py: spawn at (1,0), random diagonal descent)
+    bool spawn = !ball_live_;
+    int bdc = (int)(rng_() & 1);
+    if (spawn) {
+      ball_r_ = 1;
+      ball_c_ = 0;
+    } else {
+      ball_r_ += 1;
+      ball_c_ += bdc;
+    }
+    bool live = ball_r_ < Q::kRows;
+    if (!live) {
+      ball_r_ = 1;
+      ball_c_ = 0;
+    }
+    ball_c_ = std::clamp(ball_c_, 0, ball_r_);
+
+    bool caught = live && ball_r_ == pos_r_ && ball_c_ == pos_c_;
+    if (fell || caught) {
+      --lives_;
+      pos_r_ = pos_c_ = 0;
+    }
+    ball_live_ = live || spawn;
+
+    ++t_;
+    if (lives_ <= 0 || t_ >= Q::kMaxT) {
+      out.done = true;
+      Reset();
+    }
+    return out;
+  }
+
+  void Render(uint8_t* obs) const override {
+    namespace Q = qb;
+    std::memset(obs, 0, kH * kW);
+    for (int r = 0; r < Q::kRows; ++r)
+      for (int c = 0; c <= r; ++c) {
+        float cx = 0.5f + (c - r / 2.f) * 0.13f;
+        float cy = 0.18f + r * 0.13f;
+        int idx = r * (r + 1) / 2 + c;
+        MaxRect(obs, cx, cy, 0.05f, 0.045f, flipped_[idx] ? 200 : 100);
+      }
+    float ax = 0.5f + (pos_c_ - pos_r_ / 2.f) * 0.13f;
+    float ay = 0.18f + pos_r_ * 0.13f - 0.05f;
+    MaxRect(obs, ax, ay, 0.025f, 0.025f, 255);
+    if (ball_live_) {
+      float bx = 0.5f + (ball_c_ - ball_r_ / 2.f) * 0.13f;
+      float by = 0.18f + ball_r_ * 0.13f - 0.05f;
+      MaxRect(obs, bx, by, 0.02f, 0.02f, 160);
+    }
+  }
+
+  int NumActions() const override { return qb::kNumActions; }
+
+ private:
+  std::mt19937_64 rng_;
+  int pos_r_, pos_c_;
+  bool flipped_[qb::kCubes];
+  int ball_r_, ball_c_;
+  bool ball_live_;
+  int lives_, boards_, t_;
+};
+
 // ------------------------------------------------------------- batched ----
 class BatchedEnv {
  public:
@@ -313,6 +595,10 @@ class BatchedEnv {
         envs_.emplace_back(new PongEnv(seed + i));
       else if (name == "breakout")
         envs_.emplace_back(new BreakoutEnv(seed + i));
+      else if (name == "seaquest")
+        envs_.emplace_back(new SeaquestEnv(seed + i));
+      else if (name == "qbert")
+        envs_.emplace_back(new QbertEnv(seed + i));
       else
         envs_.clear();
       if (envs_.empty()) break;

@@ -66,10 +66,12 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_epoch", type=int, default=100)
     p.add_argument("--nr_eval", type=int, default=8)
     p.add_argument("--eval_every", type=int, default=1, help="epochs between Evaluator runs")
+    p.add_argument("--eval_max_steps", type=int, default=10000, help="greedy-eval step horizon (fused trainer; must cover a full episode)")
     p.add_argument("--num_actions", type=int, default=4)
     p.add_argument("--mesh_data", type=int, default=None, help="data-axis size (defaults to all devices)")
     p.add_argument("--publish_every", type=int, default=1)
     p.add_argument("--rollout_len", type=int, default=20, help="fused-trainer rollout length per update")
+    p.add_argument("--grad_chunk_samples", type=int, default=4096, help="fused-trainer learner chunk size (HBM activation cap)")
     p.add_argument("--actor_timeout", type=float, default=120.0, help="seconds of actor silence before its state is dropped (0=off)")
     p.add_argument("--entropy_beta_final", type=float, default=None, help="linear-anneal entropy beta to this over max_epoch (ScheduledHyperParamSetter)")
     p.add_argument("--learning_rate_final", type=float, default=None, help="linear-anneal LR to this over max_epoch (ScheduledHyperParamSetter)")
@@ -224,9 +226,12 @@ def main(argv: Optional[list] = None) -> int:
 
         start_server(args.profiler_port)
 
-    if args.task in ("eval", "play"):
+    if args.task == "eval":
         state = create_train_state(jax.random.PRNGKey(0), model, cfg, optimizer)
         return _run_eval(args, cfg, model, state)
+    if args.task == "play":
+        state = create_train_state(jax.random.PRNGKey(0), model, cfg, optimizer)
+        return _run_play(args, cfg, model, state)
 
     if args.trainer == "tpu_fused_ba3c":
         return _run_fused(args, cfg, model, optimizer)
@@ -277,6 +282,9 @@ def main(argv: Optional[list] = None) -> int:
         batch_size=cfg.predict_batch_size,
         num_threads=cfg.predictor_threads,
     )
+    # precompile every serving bucket now — a first-time bucket compile
+    # mid-training stalls the whole actor plane for tens of seconds
+    predictor.warmup(cfg.state_shape)
     c2s, s2c = default_pipes()
     score_q: queue.Queue = queue.Queue(maxsize=4096)
     n_data = mesh.shape["data"]
@@ -424,6 +432,44 @@ def _run_eval(args, cfg, model, state) -> int:
     mean, mx = eval_model(predict, build_player, args.nr_eval)
     logger.info("eval over %d episodes: mean=%.2f max=%.2f", args.nr_eval, mean, mx)
     print(f"mean_score={mean:.3f} max_score={mx:.3f}")
+    return 0
+
+
+def _run_play(args, cfg, model, state) -> int:
+    """Replay mode (reference ``play_n_episodes``): run ``--nr_eval`` greedy
+    episodes one at a time, printing per-step action/reward so a human can
+    watch the policy (no render surface in this build: the step trace IS the
+    visualization)."""
+    import jax
+    import numpy as np
+
+    from distributed_ba3c_tpu.predict.server import BatchedPredictor
+    from distributed_ba3c_tpu.train.checkpoint import CheckpointManager
+
+    if args.load:
+        mgr = CheckpointManager(args.load)
+        state = mgr.restore(jax.device_get(state))
+    predictor = BatchedPredictor(model, state.params, batch_size=1, greedy=True)
+    build_player = _build_player_factory(args, cfg)
+
+    for ep in range(max(args.nr_eval, 1)):
+        player = build_player(ep)
+        score, t = 0.0, 0
+        while True:
+            s = np.asarray(player.current_state())[None]
+            actions, values, _ = predictor.predict_batch(s)
+            a = int(actions[0])
+            r, is_over = player.action(a)
+            score += r
+            if r != 0 or t % 50 == 0:
+                print(
+                    f"episode {ep} step {t:5d} | action {a} | reward {r:+.1f} "
+                    f"| score {score:+.1f} | V(s) {float(values[0]):+.3f}"
+                )
+            t += 1
+            if is_over or t >= cfg.episode_length_cap:
+                break
+        print(f"episode {ep} finished: score {score:+.1f} in {t} steps")
     return 0
 
 

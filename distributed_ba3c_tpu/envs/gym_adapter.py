@@ -55,13 +55,20 @@ class GymEnv(RLEnvironment):
         self.score = 0.0
 
 
-def imageize_obs(obs: np.ndarray, image_size: Tuple[int, int] = (84, 84)) -> np.ndarray:
+def imageize_obs(
+    obs: np.ndarray,
+    image_size: Tuple[int, int] = (84, 84),
+    float_scale: float = 1.0,
+) -> np.ndarray:
     """Embed any observation into a uint8 [H, W] frame for the conv net.
 
     Image observations are grayscaled + resized (the AtariPlayer preproc
     path); low-dimensional vectors are tanh-squashed into per-feature
     vertical bands so classic-control envs run through the unchanged
-    BA3C pipeline.
+    BA3C pipeline. ``float_scale`` converts float frames to [0,255] — set
+    ONCE from the env's declared observation_space (255.0 for normalized
+    [0,1] spaces); per-frame autoscaling would mix intensity scales across
+    the stacked history.
     """
     obs = np.asarray(obs)
     if obs.ndim >= 2:  # image-like
@@ -70,11 +77,7 @@ def imageize_obs(obs: np.ndarray, image_size: Tuple[int, int] = (84, 84)) -> np.
         if obs.ndim == 3:
             obs = obs.mean(axis=-1)
         if np.issubdtype(obs.dtype, np.floating):
-            # normalized float frames ([0,1]) must be rescaled before the
-            # uint8 cast or every pixel truncates to 0/1 (all-black input)
-            if obs.size and obs.max() <= 1.0:
-                obs = obs * 255.0
-            obs = np.clip(obs, 0.0, 255.0)
+            obs = np.clip(obs * float_scale, 0.0, 255.0)
         return cv2.resize(obs.astype(np.uint8), image_size[::-1])
     flat = obs.astype(np.float32).ravel()
     vals = (np.tanh(flat) * 127.5 + 127.5).astype(np.uint8)
@@ -101,7 +104,16 @@ def build_gym_player(
     )
 
     env = GymEnv(name, seed=idx)
+    # decide float-frame scaling ONCE from the declared space bounds
+    space = env.gymenv.observation_space
+    high = np.asarray(getattr(space, "high", 255.0), np.float64)
+    float_scale = (
+        255.0 if np.all(np.isfinite(high)) and float(high.max()) <= 1.0 else 1.0
+    )
     mapped = MapPlayerState(
-        env, functools.partial(imageize_obs, image_size=image_size)
+        env,
+        functools.partial(
+            imageize_obs, image_size=image_size, float_scale=float_scale
+        ),
     )
     return HistoryFramePlayer(mapped, frame_history)

@@ -98,13 +98,15 @@ def make_fused_step(
     mesh: Mesh,
     env,
     rollout_len: int = 20,
-    grad_chunk_samples: int = 24576,
+    grad_chunk_samples: int = 4096,
 ) -> Callable:
     """Build fn(state, entropy_beta, lr) -> (state, metrics), fully on-device.
 
     ``grad_chunk_samples`` bounds the per-fwd+bwd batch in the learner (HBM
-    activation cap); 24576 lets the shipped 1024-env × 20-step shape run as
-    ONE flat chunk on a 16 GB v5e.
+    activation cap). Measured on the 16 GB v5e (PERF.md): 5120 fits inside
+    the full fused program, 10240 OOMs; throughput is flat across 1024-5120
+    (the convs' MXU utilization is channel-count-bound, not batch-bound), so
+    the default stays comfortably under the cliff.
     """
 
     def local_step(state: FusedState, entropy_beta, learning_rate):
@@ -310,6 +312,95 @@ def make_fused_step(
     return step
 
 
+def make_greedy_eval(
+    model: BA3CNet,
+    cfg: BA3CConfig,
+    mesh: Mesh,
+    env,
+    n_envs: int,
+    max_steps: int = 3000,
+) -> Callable:
+    """Build fn(params, key) -> (mean_return, max_return, n_episodes).
+
+    The fused trainer's Evaluator (reference ``Evaluator``/``eval_with_funcs``,
+    SURVEY.md §3.5): greedy (argmax) episodes, fully on-device — fresh envs
+    roll in lockstep under one jit; each env contributes its FIRST completed
+    episode so long-running envs don't bias the mean toward short episodes.
+    """
+
+    def local_eval(params, key):
+        B = n_envs // mesh.shape[DATA_AXIS]
+        key = key[0]
+        k_reset, key = jax.random.split(key)
+        env_state = jax.vmap(env.reset)(jax.random.split(k_reset, B))
+        # reset() fields built from constants are axis-INVARIANT under
+        # shard_map until the first data-dependent step, which breaks the
+        # env's internal scan carries — mark the whole state varying up front
+        def _to_varying(x):
+            if DATA_AXIS in getattr(jax.typeof(x), "vma", frozenset()):
+                return x  # already varying (e.g. key-derived fields)
+            return jax.lax.pcast(x, (DATA_AXIS,), to="varying")
+
+        env_state = jax.tree_util.tree_map(_to_varying, env_state)
+        obs = jax.vmap(env.render)(env_state)
+        stack = jnp.zeros((B, *obs.shape[1:], cfg.frame_history), jnp.uint8)
+        stack = stack.at[..., -1].set(obs)
+
+        def body(carry, _):
+            env_state, stack, key, ep_ret, done_ret, done_mask = carry
+            out = model.apply({"params": params}, stack)
+            actions = jnp.argmax(out.logits, axis=-1).astype(jnp.int32)
+            key, k_env = jax.random.split(key)
+            env_state, obs, reward, done = jax.vmap(env.step)(
+                env_state, actions, jax.random.split(k_env, B)
+            )
+            ep_ret = ep_ret + reward
+            first_done = done & ~done_mask
+            done_ret = jnp.where(first_done, ep_ret, done_ret)
+            done_mask = done_mask | done
+            ep_ret = ep_ret * (1.0 - done.astype(jnp.float32))
+            keep = (~done).astype(stack.dtype)[:, None, None, None]
+            stack = jnp.concatenate([stack[..., 1:] * keep, obs[..., None]], -1)
+            return (env_state, stack, key, ep_ret, done_ret, done_mask), None
+
+        carry0 = (
+            env_state,
+            stack,
+            key,
+            _to_varying(jnp.zeros(B, jnp.float32)),
+            _to_varying(jnp.zeros(B, jnp.float32)),
+            _to_varying(jnp.zeros(B, bool)),
+        )
+        (_, _, _, _, done_ret, done_mask), _ = jax.lax.scan(
+            body, carry0, None, length=max_steps
+        )
+        n = jax.lax.psum(jnp.sum(done_mask.astype(jnp.int32)), DATA_AXIS)
+        s = jax.lax.psum(jnp.sum(jnp.where(done_mask, done_ret, 0.0)), DATA_AXIS)
+        mx = jax.lax.pmax(
+            jnp.max(jnp.where(done_mask, done_ret, -jnp.inf)), DATA_AXIS
+        )
+        return s / jnp.maximum(n, 1), mx, n
+
+    sharded = jax.shard_map(
+        local_eval,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=(P(), P(), P()),
+    )
+    jitted = jax.jit(sharded)
+
+    def evaluate(params, key):
+        n_shards = mesh.shape[DATA_AXIS]
+        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+            jnp.arange(n_shards)
+        )
+        keys = jax.device_put(keys, NamedSharding(mesh, P(DATA_AXIS)))
+        mean, mx, n = jitted(params, keys)
+        return float(mean), float(mx), int(n)
+
+    return evaluate
+
+
 def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
     """CLI driver for --trainer=tpu_fused_ba3c (env must be jax:<name>)."""
     from distributed_ba3c_tpu.envs import jaxenv
@@ -329,7 +420,10 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
     rollout_len = args.rollout_len
     envs_per_device = max(1, cfg.batch_size // rollout_len)
     n_envs = envs_per_device * n_data
-    step = make_fused_step(model, optimizer, cfg, mesh, env, rollout_len)
+    step = make_fused_step(
+        model, optimizer, cfg, mesh, env, rollout_len,
+        grad_chunk_samples=args.grad_chunk_samples,
+    )
     state = create_fused_state(
         jax.random.PRNGKey(0), model, cfg, optimizer, env, n_envs, n_shards=n_data
     )
@@ -360,11 +454,17 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
         f = (epoch - 1) / (args.max_epoch - 1)
         return v0 + f * (v1 - v0)
 
-    best = -np.inf
+    # greedy on-device Evaluator (reference Evaluator, SURVEY.md §3.5):
+    # nr_eval envs rounded up to the mesh's data axis
+    n_eval = max(n_data, (max(args.nr_eval, 1) + n_data - 1) // n_data * n_data)
+    evaluate = make_greedy_eval(
+        model, cfg, mesh, env, n_eval, max_steps=args.eval_max_steps
+    )
+
     try:
         _fused_epoch_loop(
             args, cfg, step, state, holder, ckpt, samples_per_iter,
-            n_envs, sched, best,
+            n_envs, sched, evaluate,
         )
     finally:
         holder.close()
@@ -372,10 +472,12 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
 
 
 def _fused_epoch_loop(
-    args, cfg, step, state, holder, ckpt, samples_per_iter, n_envs, sched, best
+    args, cfg, step, state, holder, ckpt, samples_per_iter, n_envs, sched,
+    evaluate,
 ):
     from distributed_ba3c_tpu.utils import logger
 
+    best = -np.inf
     for epoch in range(1, args.max_epoch + 1):
         beta = sched(cfg.entropy_beta, args.entropy_beta_final, epoch)
         lr = sched(cfg.learning_rate, args.learning_rate_final, epoch)
@@ -400,7 +502,21 @@ def _fused_epoch_loop(
                 jnp.zeros(n_envs, jnp.float32), step.batch_sharding
             ),
         )
+        # greedy eval — the number the north-star (Pong >= 18) is defined on
+        eval_mean = float("nan")
+        if epoch % max(args.eval_every, 1) == 0:
+            eval_mean, eval_max, eval_n = evaluate(
+                state.train.params, jax.random.PRNGKey(1000 + epoch)
+            )
+            if eval_n > 0:
+                holder.add_stat("eval_mean_score", eval_mean)
+                holder.add_stat("eval_max_score", eval_max)
+            else:
+                # no episode finished inside the eval horizon (long rallies):
+                # 0/1 would masquerade as a real score — report nothing
+                eval_mean = float("nan")
         holder.add_stat("epoch", epoch)
+        holder.add_stat("global_step", int(state.train.step))
         holder.add_stat("fps", fps)
         if np.isfinite(mean_ret):
             holder.add_stat("mean_score", mean_ret)
@@ -408,15 +524,18 @@ def _fused_epoch_loop(
             holder.add_stat(k, metrics[k])
         holder.finalize()
         logger.info(
-            "epoch %d | env-steps/s %.0f | mean_score %.2f (%d eps) | loss %.4f entropy %.3f",
+            "epoch %d | env-steps/s %.0f | mean_score %.2f (%d eps) | eval %.2f | loss %.4f entropy %.3f",
             epoch,
             fps,
             mean_ret,
             int(metrics["episodes"]),
+            eval_mean,
             metrics["loss"],
             metrics["entropy"],
         )
         ckpt.save(jax.device_get(state.train), int(state.train.step))
-        if np.isfinite(mean_ret) and mean_ret > best:
-            best = mean_ret
-            ckpt.mark_best(int(state.train.step), mean_ret)
+        # keep-best on GREEDY EVAL (not training-policy returns): the
+        # reference's MaxSaver tracked the Evaluator's number
+        if np.isfinite(eval_mean) and eval_mean > best:
+            best = eval_mean
+            ckpt.mark_best(int(state.train.step), eval_mean)

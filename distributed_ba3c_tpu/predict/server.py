@@ -54,10 +54,12 @@ class BatchedPredictor:
         num_threads: int = 1,
         seed: int = 0,
         greedy: bool = False,
+        coalesce_ms: float = 2.0,
     ):
         self._model = model
         self._params = jax.device_put(params)
         self._batch_size = batch_size
+        self._coalesce_s = coalesce_ms / 1000.0
         self._queue: "queue.Queue[Tuple[np.ndarray, Callable]]" = queue.Queue(
             maxsize=4096
         )
@@ -75,7 +77,21 @@ class BatchedPredictor:
             # log mu(a|s): the behavior policy record V-trace needs
             log_probs = jax.nn.log_softmax(out.logits, axis=-1)
             logp = jnp.take_along_axis(log_probs, actions[:, None], axis=-1)[:, 0]
-            return actions, out.value, logp, out.logits
+            # PACK everything into ONE array: the host fetches a single
+            # buffer per serve. Measured on the tunneled-TPU dev setup:
+            # device readback costs ~135 ms PER ARRAY regardless of size
+            # (latency, not bandwidth), so four separate fetches were 540 ms
+            # per serving call — 400x the 1.3 ms compute (see PERF.md).
+            greedy_actions = jnp.argmax(out.logits, axis=-1)
+            packed = jnp.stack(
+                [
+                    actions.astype(jnp.float32),
+                    out.value,
+                    logp,
+                    greedy_actions.astype(jnp.float32),
+                ]
+            )
+            return packed  # [4, B] float32
 
         self._fwd = jax.jit(fwd_sample)
         self.threads: List[StoppableThread] = [
@@ -89,6 +105,17 @@ class BatchedPredictor:
     def start(self) -> None:
         for t in self.threads:
             t.start()
+
+    def warmup(self, state_shape, dtype=np.uint8) -> None:
+        """Precompile every pow-2 bucket up to batch_size.
+
+        Each new bucket size triggers a fresh XLA compile (tens of seconds
+        on TPU) the first time it is served; hitting that mid-training
+        stalls the whole actor plane. Call once before actors start."""
+        b = 1
+        while b <= _next_pow2(self._batch_size):
+            self._run_device(np.zeros((b, *state_shape), dtype))
+            b *= 2
 
     def stop(self) -> None:
         for t in self.threads:
@@ -115,9 +142,13 @@ class BatchedPredictor:
     def predict_batch(
         self, states: np.ndarray
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Synchronous batched predict: (actions, values, logits) as numpy."""
-        actions, values, _, logits = self._run_device(np.asarray(states))
-        return actions, values, logits
+        """Synchronous batched predict: (actions, values, greedy_actions).
+
+        ``actions`` follow the serving policy (sampled, or argmax when
+        ``greedy=True``); ``greedy_actions`` are always the argmax — the
+        Evaluator consumes those without a second device call."""
+        actions, values, _, greedy_actions = self._run_device(np.asarray(states))
+        return actions, values, greedy_actions
 
     # -- internals ---------------------------------------------------------
     def _next_key(self):
@@ -131,26 +162,40 @@ class BatchedPredictor:
         if padded != k:
             pad = np.zeros((padded - k, *batch.shape[1:]), batch.dtype)
             batch = np.concatenate([batch, pad], axis=0)
-        actions, values, logps, logits = self._fwd(
-            self._params, batch, self._next_key()
+        packed = np.asarray(  # ONE device->host fetch (see fwd_sample)
+            self._fwd(self._params, batch, self._next_key())
         )
         return (
-            np.asarray(actions)[:k],
-            np.asarray(values)[:k],
-            np.asarray(logps)[:k],
-            np.asarray(logits)[:k],
+            packed[0, :k].astype(np.int32),
+            packed[1, :k],
+            packed[2, :k],
+            packed[3, :k].astype(np.int32),
         )
 
     def _fetch_batch(self, t: StoppableThread):
-        """Block for one task, then drain without waiting (reference
-        ``PredictorWorkerThread.fetch_batch`` semantics)."""
+        """Block for one task, then coalesce toward a full batch.
+
+        The reference's ``fetch_batch`` drained greedily — right when a
+        ``sess.run`` cost microseconds on local CPU. Here one device call
+        costs ~1-10 ms of (possibly tunneled) dispatch latency, so waiting
+        up to ``coalesce_ms`` to multiply the batch is a large win for the
+        actor plane (measured: greedy draining served tiny batches and
+        collapsed ZMQ-plane throughput). ``coalesce_ms=0`` restores the
+        reference behavior."""
+        import time as _time
+
         first = t.queue_get_stoppable(self._queue)
         if first is None:
             return None
         tasks = [first]
+        deadline = _time.perf_counter() + self._coalesce_s
         while len(tasks) < self._batch_size:
+            remaining = deadline - _time.perf_counter()
             try:
-                tasks.append(self._queue.get_nowait())
+                if remaining > 0:
+                    tasks.append(self._queue.get(timeout=remaining))
+                else:
+                    tasks.append(self._queue.get_nowait())
             except queue.Empty:
                 break
         return tasks

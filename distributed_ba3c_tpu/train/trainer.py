@@ -7,8 +7,9 @@ TPU-first:
 - ``run_step``'s ``sess.run(train_op)`` + async PS gradient push becomes one
   jitted shard_map step with the grads psum'd over the mesh (§3.4 replaced).
 - ``QueueInput``/``EnqueueThread`` become ``TrainFeed`` (host batcher thread)
-  + async ``jax.device_put`` against the batch sharding, so H2D overlaps the
-  device step.
+  + double-buffered ``jax.device_put``: the next batch is staged while the
+  (asynchronously dispatched) device step runs, so batching + H2D transfer
+  overlap compute.
 - The predict towers' shared-variable reads become an explicit params publish
   to the BatchedPredictor every ``publish_every`` steps (on-device ref swap,
   no host copy).
@@ -86,8 +87,8 @@ class Trainer:
         assert self.predictor is not None
 
         def predict(states: np.ndarray) -> np.ndarray:
-            _, _, logits = self.predictor.predict_batch(states)
-            return logits.argmax(-1)
+            _, _, greedy_actions = self.predictor.predict_batch(states)
+            return greedy_actions
 
         return predict
 
@@ -122,13 +123,22 @@ class Trainer:
             return jax.make_array_from_process_local_data(sharding, v)
         return jax.device_put(v, sharding)
 
-    def run_step(self) -> None:
+    def _next_device_batch(self):
         batch = self.feed.next_batch(timeout=self.config.feed_timeout)
         sharding = self.step_fn.batch_sharding
         if isinstance(sharding, dict):
-            batch = {k: self._put(v, sharding[k]) for k, v in batch.items()}
-        else:
-            batch = {k: self._put(v, sharding) for k, v in batch.items()}
+            return {k: self._put(v, sharding[k]) for k, v in batch.items()}
+        return {k: self._put(v, sharding) for k, v in batch.items()}
+
+    def run_step(self) -> None:
+        # Double buffering: staging happens at the HEAD of each step, while
+        # the device is still executing the previous step's asynchronously
+        # dispatched computation — so host batching + H2D transfer overlap
+        # compute (the round-1 loop was a synchronous put-then-step), and
+        # no surplus batch is fetched after the final step (a post-step
+        # staging fetch could starve at shutdown and discard the completed
+        # step's accounting).
+        batch = self._next_device_batch()
         self.state, self.metrics = self.step_fn(
             self.state,
             batch,

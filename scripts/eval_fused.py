@@ -1,0 +1,83 @@
+"""Standalone greedy evaluation of a fused-trainer checkpoint.
+
+Usage:
+    python scripts/eval_fused.py --env jax:pong \
+        --load runs/pong_northstar/checkpoints [--step N] \
+        --nr_eval 32 --max_steps 20000
+
+Loads the TrainState from orbax, runs the on-device greedy Evaluator with a
+horizon long enough for full episodes, prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+
+from distributed_ba3c_tpu.config import BA3CConfig
+from distributed_ba3c_tpu.envs import jaxenv
+from distributed_ba3c_tpu.fused.loop import make_greedy_eval
+from distributed_ba3c_tpu.models.a3c import BA3CNet
+from distributed_ba3c_tpu.ops.gradproc import make_optimizer
+from distributed_ba3c_tpu.parallel.mesh import make_mesh
+from distributed_ba3c_tpu.parallel.train_step import create_train_state
+from distributed_ba3c_tpu.train.checkpoint import CheckpointManager
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--env", default="jax:pong")
+    ap.add_argument("--load", required=True)
+    ap.add_argument("--step", type=int, default=None)
+    ap.add_argument("--best", action="store_true", help="use the best-marked step")
+    ap.add_argument("--nr_eval", type=int, default=32)
+    ap.add_argument("--max_steps", type=int, default=20000)
+    ap.add_argument("--fc_units", type=int, default=512)
+    args = ap.parse_args()
+
+    env = jaxenv.get_env(args.env.split(":", 1)[1])
+    cfg = BA3CConfig(num_actions=env.num_actions, fc_units=args.fc_units)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    opt = make_optimizer(cfg.learning_rate, cfg.adam_epsilon, cfg.grad_clip_norm)
+    target = create_train_state(jax.random.PRNGKey(0), model, cfg, opt)
+
+    mgr = CheckpointManager(args.load)
+    step = args.step
+    if args.best and step is None:
+        step = mgr.best_step
+    state = mgr.restore(jax.device_get(target), step)
+
+    mesh = make_mesh()
+    n_data = mesh.shape["data"]
+    n_eval = max(n_data, (args.nr_eval + n_data - 1) // n_data * n_data)
+    evaluate = make_greedy_eval(
+        model, cfg, mesh, env, n_eval, max_steps=args.max_steps
+    )
+    mean, mx, n = evaluate(state.params, jax.random.PRNGKey(123))
+    print(
+        json.dumps(
+            {
+                "env": args.env,
+                "ckpt_step": int(state.step),
+                # n==0: no episode finished inside the horizon — 0.0/-inf
+                # would masquerade as scores (and -Infinity is invalid JSON)
+                "eval_mean_score": round(mean, 3) if n > 0 else None,
+                "eval_max_score": round(mx, 3) if n > 0 else None,
+                "episodes": n,
+                "max_steps": args.max_steps,
+            }
+        )
+    )
+    if n == 0:
+        raise SystemExit(
+            "no episode completed within --max_steps; raise the horizon"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -159,10 +159,6 @@ def bench_shape(n_envs: int, rollout_len: int):
                 return l.total, l
             return jax.value_and_grad(loss_fn, has_aux=True)(p)
 
-        def acc(carry, chunk):
-            (_, _), g = chunk_grad(params, chunk), None
-            return carry, None
-
         def acc_body(g_acc, chunk):
             (_, _), g = chunk_grad(params, chunk)
             return jax.tree_util.tree_map(jnp.add, g_acc, g), None

@@ -29,18 +29,20 @@ def test_next_pow2():
 def test_sync_predict_shapes_and_padding():
     cfg, _, pred = _make()
     states = np.zeros((5, *cfg.state_shape), np.uint8)  # pads to 8
-    actions, values, logits = pred.predict_batch(states)
+    actions, values, greedy = pred.predict_batch(states)
     assert actions.shape == (5,) and values.shape == (5,)
-    assert logits.shape == (5, cfg.num_actions)
+    assert greedy.shape == (5,)
     assert ((actions >= 0) & (actions < cfg.num_actions)).all()
+    assert ((greedy >= 0) & (greedy < cfg.num_actions)).all()
 
 
 def test_greedy_matches_argmax():
     cfg, model, pred = _make(greedy=True)
     rng = np.random.default_rng(0)
     states = rng.integers(0, 255, (4, *cfg.state_shape), np.uint8)
-    actions, _, logits = pred.predict_batch(states)
-    np.testing.assert_array_equal(actions, logits.argmax(-1))
+    actions, _, greedy = pred.predict_batch(states)
+    # with greedy=True the serving actions ARE the argmax channel
+    np.testing.assert_array_equal(actions, greedy)
 
 
 def test_async_callbacks_all_fire():
@@ -78,10 +80,10 @@ def test_async_callbacks_all_fire():
 def test_update_params_changes_output():
     cfg, model, pred = _make(greedy=True)
     states = np.full((2, *cfg.state_shape), 128, np.uint8)
-    _, _, logits_before = pred.predict_batch(states)
+    _, values_before, _ = pred.predict_batch(states)
     new_params = model.init(
         jax.random.PRNGKey(7), np.zeros((1, *cfg.state_shape), np.uint8)
     )["params"]
     pred.update_params(new_params)
-    _, _, logits_after = pred.predict_batch(states)
-    assert not np.allclose(logits_before, logits_after)
+    _, values_after, _ = pred.predict_batch(states)
+    assert not np.allclose(values_before, values_after)

@@ -1,0 +1,49 @@
+"""V-trace under REAL actor/learner lag, end-to-end (BASELINE config #4).
+
+The entire reason the V-trace component exists: with ``--publish_every 8``
+the behavior policy serving the simulators is up to 8 updates stale, so the
+experience is genuinely off-policy. The importance-corrected learner must
+still reach near-optimum on the FakeEnv MDP, and must do at least as well
+as the uncorrected sync A2C learner under the identical lag.
+"""
+
+import json
+import os
+
+import pytest
+
+from distributed_ba3c_tpu.cli import main
+
+
+def _run(trainer: str, logdir: str) -> dict:
+    rc = main(
+        [
+            "--trainer", trainer,
+            "--env", "fake",
+            "--publish_every", "8",
+            "--simulator_procs", "4",
+            "--batch_size", "32",
+            "--image_size", "16",
+            "--fc_units", "16",
+            "--steps_per_epoch", "80",
+            "--max_epoch", "2",
+            "--nr_eval", "4",
+            "--logdir", logdir,
+        ]
+    )
+    assert rc == 0
+    stats = json.load(open(os.path.join(logdir, "stat.json")))
+    return stats[-1]
+
+
+@pytest.mark.slow
+def test_vtrace_learns_under_lag_and_matches_or_beats_sync(tmp_path):
+    vt = _run("tpu_vtrace_ba3c", str(tmp_path / "vtrace"))
+    # the importance-corrected learner must solve the MDP despite the stale
+    # behavior policy (greedy optimum = 1.0)
+    assert vt["eval_mean_score"] >= 0.75, vt
+
+    sync = _run("tpu_sync_ba3c", str(tmp_path / "sync"))
+    # and be no worse than the uncorrected learner under identical lag
+    # (small tolerance: both may saturate the easy MDP)
+    assert vt["eval_mean_score"] >= sync["eval_mean_score"] - 0.1, (vt, sync)
