@@ -92,7 +92,8 @@ def bench_zmq_plane(
     from distributed_ba3c_tpu.models.a3c import BA3CNet
     from distributed_ba3c_tpu.predict.server import BatchedPredictor
 
-    cfg = BA3CConfig(num_actions=6, predict_batch_size=256)
+    n_actions = native.CppBatchedEnv(game, 1).num_actions
+    cfg = BA3CConfig(num_actions=n_actions, predict_batch_size=256)
     model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
     params = model.init(
         jax.random.PRNGKey(0), np.zeros((1, *cfg.state_shape), np.uint8)

@@ -331,18 +331,19 @@ class BreakoutEnv : public Env {
 };
 
 // jax-parity rasterizer: pixel-center inequality |Xc-cx|<=hw (matches the
-// jnp renders in envs/jaxenv/, which DrawRect's floor/ceil does not)
+// jnp renders in envs/jaxenv/, which DrawRect's floor/ceil does not).
+// Row/col bounds computed directly so cost is the rectangle's area, not
+// the whole 84x84 frame (Render is the env hot path).
 inline void MaxRect(uint8_t* obs, float cx, float cy, float hw, float hh,
                     uint8_t v) {
-  for (int y = 0; y < kH; ++y) {
-    float Yc = (y + 0.5f) / kH;
-    if (std::fabs(Yc - cy) > hh) continue;
-    for (int x = 0; x < kW; ++x) {
-      float Xc = (x + 0.5f) / kW;
-      if (std::fabs(Xc - cx) <= hw)
-        obs[y * kW + x] = std::max(obs[y * kW + x], v);
-    }
-  }
+  // (x+0.5)/kW in [cx-hw, cx+hw]  <=>  x in [(cx-hw)*kW-0.5, (cx+hw)*kW-0.5]
+  int x0 = std::max(0, (int)std::ceil((cx - hw) * kW - 0.5f));
+  int x1 = std::min(kW - 1, (int)std::floor((cx + hw) * kW - 0.5f));
+  int y0 = std::max(0, (int)std::ceil((cy - hh) * kH - 0.5f));
+  int y1 = std::min(kH - 1, (int)std::floor((cy + hh) * kH - 0.5f));
+  for (int y = y0; y <= y1; ++y)
+    for (int x = x0; x <= x1; ++x)
+      obs[y * kW + x] = std::max(obs[y * kW + x], v);
 }
 
 class SeaquestEnv : public Env {

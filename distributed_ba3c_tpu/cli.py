@@ -205,6 +205,8 @@ def main(argv: Optional[list] = None) -> int:
     if distributed and not is_chief():
         # non-chief hosts keep their own log dir (chief owns stat.json)
         args.logdir = f"{args.logdir}-worker{args.task_index}"
+    # shared checkpoint dir for ALL trainers incl. fused (collective saves)
+    args.shared_ckpt_dir = os.path.join(base_logdir, "checkpoints")
 
     from distributed_ba3c_tpu.models.a3c import BA3CNet
     from distributed_ba3c_tpu.ops.gradproc import make_optimizer
@@ -474,19 +476,6 @@ def _run_play(args, cfg, model, state) -> int:
 
 
 def _run_fused(args, cfg, model, optimizer) -> int:
-    import jax
-
-    if jax.process_count() > 1:
-        # the fused path builds per-host meshes and device_puts host arrays;
-        # multi-process wiring (make_global_mesh + process-local puts) is the
-        # ZMQ trainers' path today — fail loudly instead of crashing deep in
-        # device_put with a non-addressable-sharding error
-        raise SystemExit(
-            "--trainer=tpu_fused_ba3c does not support --worker_hosts yet; "
-            "multi-host training uses --trainer=tpu_sync_ba3c/tpu_vtrace_ba3c "
-            "(the fused trainer scales across the chips of one host via its "
-            "device mesh)"
-        )
     try:
         from distributed_ba3c_tpu.fused.loop import run_fused_training
     except ImportError:

@@ -104,12 +104,14 @@ def build_gym_player(
     )
 
     env = GymEnv(name, seed=idx)
-    # decide float-frame scaling ONCE from the declared space bounds
+    # decide float-frame scaling ONCE from the declared space bounds:
+    # only a finite high > 1 means "already pixel-scaled"; normalized [0,1]
+    # spaces AND envs with inf/undeclared bounds (normalizer wrappers) get
+    # the x255 — per-frame autoscaling would mix scales across the history
     space = env.gymenv.observation_space
-    high = np.asarray(getattr(space, "high", 255.0), np.float64)
-    float_scale = (
-        255.0 if np.all(np.isfinite(high)) and float(high.max()) <= 1.0 else 1.0
-    )
+    high = np.asarray(getattr(space, "high", np.inf), np.float64)
+    declared_pixel_range = np.all(np.isfinite(high)) and float(high.max()) > 1.0
+    float_scale = 1.0 if declared_pixel_range else 255.0
     mapped = MapPlayerState(
         env,
         functools.partial(

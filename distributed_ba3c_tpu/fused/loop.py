@@ -292,19 +292,39 @@ def make_fused_step(
     replicated = NamedSharding(mesh, P())
     batched = NamedSharding(mesh, batch_spec)
 
+    def _put_batched(x):
+        """Host array (GLOBAL shape) -> array sharded on the data axis.
+
+        Multi-host: every process builds the identical global state (same
+        PRNG seed) and contributes its host-major row block — the mesh's
+        data axis is laid out host-major (parallel/distributed.py), so the
+        local rows are exactly this process's slice."""
+        n_proc = jax.process_count()
+        if n_proc == 1:
+            return jax.device_put(x, batched)
+        x = np.asarray(x)
+        B = x.shape[0]
+        assert B % n_proc == 0, (B, n_proc)
+        per = B // n_proc
+        k = jax.process_index()
+        return jax.make_array_from_process_local_data(
+            batched, x[k * per : (k + 1) * per]
+        )
+
     def put(state: FusedState) -> FusedState:
         """device_put a host FusedState with the step's shardings."""
         return FusedState(
             train=jax.device_put(state.train, replicated),
-            env_state=jax.device_put(state.env_state, batched),
-            obs_stack=jax.device_put(state.obs_stack, batched),
-            key=jax.device_put(state.key, batched),
-            ep_return=jax.device_put(state.ep_return, batched),
-            ep_count=jax.device_put(state.ep_count, batched),
-            ep_return_sum=jax.device_put(state.ep_return_sum, batched),
+            env_state=jax.tree_util.tree_map(_put_batched, state.env_state),
+            obs_stack=_put_batched(state.obs_stack),
+            key=_put_batched(state.key),
+            ep_return=_put_batched(state.ep_return),
+            ep_count=_put_batched(state.ep_count),
+            ep_return_sum=_put_batched(state.ep_return_sum),
         )
 
     step.put = put
+    step.put_batched = _put_batched
     step.replicated_sharding = replicated
     step.batch_sharding = batched
     step.mesh = mesh
@@ -328,9 +348,13 @@ def make_greedy_eval(
     episode so long-running envs don't bias the mean toward short episodes.
     """
 
-    def local_eval(params, key):
+    def local_eval(params, seed):
         B = n_envs // mesh.shape[DATA_AXIS]
-        key = key[0]
+        # per-shard stream from a replicated seed: axis_index-folding keeps
+        # this multi-host safe (no host-side sharded key array to assemble)
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(seed), jax.lax.axis_index(DATA_AXIS)
+        )
         k_reset, key = jax.random.split(key)
         env_state = jax.vmap(env.reset)(jax.random.split(k_reset, B))
         # reset() fields built from constants are axis-INVARIANT under
@@ -384,18 +408,21 @@ def make_greedy_eval(
     sharded = jax.shard_map(
         local_eval,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS)),
+        in_specs=(P(), P()),
         out_specs=(P(), P(), P()),
     )
     jitted = jax.jit(sharded)
 
-    def evaluate(params, key):
-        n_shards = mesh.shape[DATA_AXIS]
-        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
-            jnp.arange(n_shards)
+    def evaluate(params, seed):
+        """``seed``: int (preferred) — PRNGKey arrays are coerced."""
+        arr = np.asarray(
+            jax.random.key_data(seed)
+            if jnp.issubdtype(getattr(seed, "dtype", np.int32), jax.dtypes.prng_key)
+            else seed
         )
-        keys = jax.device_put(keys, NamedSharding(mesh, P(DATA_AXIS)))
-        mean, mx, n = jitted(params, keys)
+        if arr.ndim:
+            arr = arr.reshape(-1)[-1]
+        mean, mx, n = jitted(params, jnp.uint32(arr))
         return float(mean), float(mx), int(n)
 
     return evaluate
@@ -415,7 +442,14 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
     cfg = cfg.replace(num_actions=env.num_actions)
     model = dataclasses.replace(model, num_actions=env.num_actions)
 
-    mesh = make_mesh(num_data=args.mesh_data, num_model=1)
+    if jax.process_count() > 1:
+        # multi-host: global host-major mesh; every process runs this loop
+        # in lockstep (the psum inside the step synchronizes the update)
+        from distributed_ba3c_tpu.parallel.distributed import make_global_mesh
+
+        mesh = make_global_mesh(num_model=1)
+    else:
+        mesh = make_mesh(num_data=args.mesh_data, num_model=1)
     n_data = mesh.shape[DATA_AXIS]
     rollout_len = args.rollout_len
     envs_per_device = max(1, cfg.batch_size // rollout_len)
@@ -435,7 +469,10 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
     state = step.put(state)
 
     holder = StatHolder(args.logdir)
-    ckpt = CheckpointManager(f"{args.logdir}/checkpoints")
+    # one SHARED checkpoint dir across hosts (orbax saves are collective)
+    ckpt = CheckpointManager(
+        getattr(args, "shared_ckpt_dir", None) or f"{args.logdir}/checkpoints"
+    )
     logger.set_logger_dir(args.logdir)
     samples_per_iter = n_envs * rollout_len
     logger.info(
@@ -495,18 +532,14 @@ def _fused_epoch_loop(
         )
         # reset the per-env episode accumulators for the next window
         state = state.replace(
-            ep_count=jax.device_put(
-                jnp.zeros(n_envs, jnp.int32), step.batch_sharding
-            ),
-            ep_return_sum=jax.device_put(
-                jnp.zeros(n_envs, jnp.float32), step.batch_sharding
-            ),
+            ep_count=step.put_batched(jnp.zeros(n_envs, jnp.int32)),
+            ep_return_sum=step.put_batched(jnp.zeros(n_envs, jnp.float32)),
         )
         # greedy eval — the number the north-star (Pong >= 18) is defined on
         eval_mean = float("nan")
         if epoch % max(args.eval_every, 1) == 0:
             eval_mean, eval_max, eval_n = evaluate(
-                state.train.params, jax.random.PRNGKey(1000 + epoch)
+                state.train.params, 1000 + epoch
             )
             if eval_n > 0:
                 holder.add_stat("eval_mean_score", eval_mean)

@@ -46,6 +46,12 @@ class BA3CNet(nn.Module):
     # maxpool after first 3 conv layers, as in the reference stack
     pooled_layers: Tuple[bool, ...] = (True, True, True, False)
     compute_dtype: jnp.dtype = jnp.bfloat16
+    # lane-packing factor per conv layer (models/packed_conv.py). MEASURED
+    # NEUTRAL on v5e (PERF.md: the net is HBM-roofline-bound, and XLA's conv
+    # emitter already packs output lanes) — kept as tested infrastructure
+    # for backends where the GEMM shape does bind. 0/1 = plain nn.Conv.
+    # Numerically EXACT either way (value- and gradient-tested).
+    conv_pack: Tuple[int, ...] = (0, 0, 0, 0)
 
     @nn.compact
     def __call__(self, state: jax.Array) -> PolicyValue:
@@ -55,16 +61,38 @@ class BA3CNet(nn.Module):
         else:
             x = state.astype(self.compute_dtype)
 
-        for feats, k, pooled in zip(
-            self.conv_features, self.conv_kernels, self.pooled_layers, strict=True
+        for i, (feats, k, pooled, pack) in enumerate(
+            zip(
+                self.conv_features,
+                self.conv_kernels,
+                self.pooled_layers,
+                self.conv_pack,
+                strict=True,
+            )
         ):
-            x = nn.Conv(
-                features=feats,
-                kernel_size=(k, k),
-                padding="SAME",
-                dtype=self.compute_dtype,
-                param_dtype=jnp.float32,
-            )(x)
+            # explicit name "Conv_i" for BOTH branches: PackedConv owns
+            # nn.Conv-shaped params, so checkpoints stay interchangeable
+            # between packed and plain configurations
+            if pack and pack > 1:
+                from distributed_ba3c_tpu.models.packed_conv import PackedConv
+
+                x = PackedConv(
+                    features=feats,
+                    kernel_size=k,
+                    pack=pack,
+                    dtype=self.compute_dtype,
+                    param_dtype=jnp.float32,
+                    name=f"Conv_{i}",
+                )(x)
+            else:
+                x = nn.Conv(
+                    features=feats,
+                    kernel_size=(k, k),
+                    padding="SAME",
+                    dtype=self.compute_dtype,
+                    param_dtype=jnp.float32,
+                    name=f"Conv_{i}",
+                )(x)
             x = nn.relu(x)
             if pooled:
                 x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))

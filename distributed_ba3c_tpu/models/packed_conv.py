@@ -1,0 +1,105 @@
+"""Lane-packed convolution: fold spatial positions into MXU output lanes.
+
+Why: the reference BA3C net's first two convs have 32 output channels
+(SURVEY.md §2.1 #2). On TPU a conv lowers to an implicit GEMM whose
+output-channel dimension maps onto the MXU's 128 lanes — at 32 channels,
+3/4 of the systolic array idles, capping the whole fused trainer at ~24%
+MFU (measured; PERF.md). This module reformulates a stride-1 SAME conv as
+an equivalent strided conv computing P adjacent output columns per window:
+
+    out[y, P*j+dx, c] = sum_{ky,kx,ci} xpad[y+ky, P*j+dx+kx, ci] * W[ky,kx,ci,c]
+
+Build W'[ky, kx', ci, dx*C+c] = W[ky, kx'-dx, ci, c] (zero outside), then
+
+    out' = conv(xpad, W', window (kh, kw+P-1), strides (1, P), VALID)
+
+has P*C output channels; reshaping [B, H, W/P, P, C] -> [B, H, W, C]
+recovers the exact stride-1 result. Cost: (kw+P-1)/kw more MACs, paid at
+P-fold better lane occupancy — net ~2-2.5x for kw=5, C=32, P in {3,4}
+(measured on v5e; see PERF.md). Everything is differentiable jnp/lax, so
+the backward pass inherits the packing through XLA's conv transposes.
+
+Parameter names/shapes match ``flax.linen.Conv`` ('kernel' [kh,kw,cin,cout],
+'bias' [cout]) — checkpoints are interchangeable with the plain layer.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pack_kernel(w: jax.Array, pack: int) -> jax.Array:
+    """[kh, kw, ci, co] -> [kh, kw+pack-1, ci, pack*co] shifted-stack."""
+    parts = [
+        jnp.pad(w, ((0, 0), (dx, pack - 1 - dx), (0, 0), (0, 0)))
+        for dx in range(pack)
+    ]
+    return jnp.concatenate(parts, axis=-1)
+
+
+def packed_conv_same(
+    x: jax.Array, w: jax.Array, pack: int
+) -> jax.Array:
+    """Stride-1 SAME conv [B,H,W,Ci] x [kh,kw,Ci,Co] via lane packing.
+
+    Requires W % pack == 0 and odd kernel sizes (SAME centering).
+    """
+    kh, kw, _, co = w.shape
+    B, H, W, _ = x.shape
+    assert W % pack == 0, (W, pack)
+    ph, pw = kh // 2, kw // 2
+    xpad = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    wp = _pack_kernel(w, pack)
+    out = lax.conv_general_dilated(
+        xpad,
+        wp,
+        window_strides=(1, pack),
+        padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=x.dtype,
+    )
+    # [B, H, W/pack, pack*co] -> [B, H, W, co]
+    return out.reshape(B, H, W // pack, pack, co).reshape(B, H, W, co)
+
+
+class PackedConv(nn.Module):
+    """Drop-in for ``nn.Conv(features, (k,k), SAME)`` with lane packing.
+
+    Falls back to the plain conv when the input width is not divisible by
+    ``pack`` (or pack==1), so the module is always correct.
+    """
+
+    features: int
+    kernel_size: int
+    pack: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        k = self.kernel_size
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (k, k, x.shape[-1], self.features),
+            self.param_dtype,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.features,), self.param_dtype
+        )
+        x = x.astype(self.dtype)
+        w = kernel.astype(self.dtype)
+        if self.pack > 1 and x.shape[2] % self.pack == 0:
+            y = packed_conv_same(x, w, self.pack)
+        else:
+            y = lax.conv_general_dilated(
+                x,
+                w,
+                window_strides=(1, 1),
+                padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+        return y + bias.astype(self.dtype)

@@ -131,13 +131,13 @@ class Trainer:
         return {k: self._put(v, sharding) for k, v in batch.items()}
 
     def run_step(self) -> None:
-        # Double buffering: staging happens at the HEAD of each step, while
-        # the device is still executing the previous step's asynchronously
-        # dispatched computation — so host batching + H2D transfer overlap
-        # compute (the round-1 loop was a synchronous put-then-step), and
-        # no surplus batch is fetched after the final step (a post-step
-        # staging fetch could starve at shutdown and discard the completed
-        # step's accounting).
+        # Overlap note: step_fn dispatch is ASYNC, so fetching/staging the
+        # next batch at the head of the next call already overlaps the
+        # device's execution of this step — no explicit double buffer is
+        # needed (and none is claimed; a post-step staging fetch was tried
+        # and reverted: it could starve at shutdown and discard the final
+        # step's accounting). The overlap is bounded by trigger_step
+        # callbacks that fetch metrics (StatPrinter samples every N steps).
         batch = self._next_device_batch()
         self.state, self.metrics = self.step_fn(
             self.state,

@@ -50,6 +50,11 @@ def main():
     step = args.step
     if args.best and step is None:
         step = mgr.best_step
+        if step is None:
+            raise SystemExit(
+                "--best: no best-marked checkpoint in this run "
+                "(eval never improved); pass --step or drop --best"
+            )
     state = mgr.restore(jax.device_get(target), step)
 
     mesh = make_mesh()
@@ -58,7 +63,7 @@ def main():
     evaluate = make_greedy_eval(
         model, cfg, mesh, env, n_eval, max_steps=args.max_steps
     )
-    mean, mx, n = evaluate(state.params, jax.random.PRNGKey(123))
+    mean, mx, n = evaluate(state.params, 123)
     print(
         json.dumps(
             {

@@ -92,6 +92,31 @@ def run_step_mode(rank: int, nprocs: int, coordinator: str) -> None:
     print(f"LOSS {float(metrics['loss']):.10e}", flush=True)
 
 
+def run_fused_mode(rank: int, nprocs: int, coordinator: str, logdir: str) -> None:
+    """Full fused trainer over 2 real processes: jax.distributed + global
+    mesh + per-process env shards + collective checkpoint saves."""
+    from distributed_ba3c_tpu.cli import main
+
+    hosts = ",".join([coordinator] + [f"x{i}:0" for i in range(1, nprocs)])
+    rc = main(
+        [
+            "--trainer", "tpu_fused_ba3c",
+            "--env", "jax:pong",
+            "--worker_hosts", hosts,
+            "--task_index", str(rank),
+            "--batch_size", "8",
+            "--rollout_len", "2",
+            "--fc_units", "16",
+            "--steps_per_epoch", "2",
+            "--max_epoch", "1",
+            "--nr_eval", "2",
+            "--eval_max_steps", "16",
+            "--logdir", logdir,
+        ]
+    )
+    print(f"CLI_RC {rc}", flush=True)
+
+
 def run_cli_mode(rank: int, nprocs: int, coordinator: str, logdir: str) -> None:
     from distributed_ba3c_tpu.cli import main
 
@@ -130,5 +155,7 @@ if __name__ == "__main__":
 
     if mode == "cli":
         run_cli_mode(rank, nprocs, coordinator, sys.argv[5])
+    elif mode == "fused":
+        run_fused_mode(rank, nprocs, coordinator, sys.argv[5])
     else:
         run_step_mode(rank, nprocs, coordinator)

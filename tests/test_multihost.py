@@ -99,6 +99,19 @@ def test_two_process_psum_update_identical_and_matches_single():
 
 
 @pytest.mark.slow
+def test_two_process_fused_trainer(tmp_path):
+    """Fused on-device trainer across 2 processes: global mesh, per-host env
+    shards, psum'd update, collective checkpoint saves — one epoch runs and
+    both ranks exit 0 with the shared checkpoint written."""
+    logdir = str(tmp_path / "flog")
+    outs = _run_pair("fused", logdir, timeout=420)
+    for out in outs:
+        assert _grep(out, "CLI_RC") == "0"
+    assert os.path.isdir(os.path.join(logdir, "checkpoints")), outs[0]
+    assert os.path.isfile(os.path.join(logdir, "stat.json")), outs[0]
+
+
+@pytest.mark.slow
 def test_two_process_cli_fake_env_trains(tmp_path):
     logdir = str(tmp_path / "log")
     outs = _run_pair("cli", logdir, timeout=420)
