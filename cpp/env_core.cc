@@ -330,20 +330,28 @@ class BreakoutEnv : public Env {
   bool in_play_;
 };
 
-// jax-parity rasterizer: pixel-center inequality |Xc-cx|<=hw (matches the
-// jnp renders in envs/jaxenv/, which DrawRect's floor/ceil does not).
-// Row/col bounds computed directly so cost is the rectangle's area, not
-// the whole 84x84 frame (Render is the env hot path).
+// jax-parity rasterizer: pixel-center inequality |Xc-cx|<=hw in float32,
+// EXACTLY as the jnp renders evaluate it (envs/jaxenv/seaquest.py etc.) —
+// closed-form ceil/floor bounds can disagree by one boundary pixel because
+// (cx+hw)*kW and (x+0.5)/kW round differently in float32. The closed form
+// only prunes the scan range (with a 1-pixel safety margin); the per-pixel
+// float32 test decides membership, so cost stays ~the rectangle's area
+// while parity stays exact.
 inline void MaxRect(uint8_t* obs, float cx, float cy, float hw, float hh,
                     uint8_t v) {
-  // (x+0.5)/kW in [cx-hw, cx+hw]  <=>  x in [(cx-hw)*kW-0.5, (cx+hw)*kW-0.5]
-  int x0 = std::max(0, (int)std::ceil((cx - hw) * kW - 0.5f));
-  int x1 = std::min(kW - 1, (int)std::floor((cx + hw) * kW - 0.5f));
-  int y0 = std::max(0, (int)std::ceil((cy - hh) * kH - 0.5f));
-  int y1 = std::min(kH - 1, (int)std::floor((cy + hh) * kH - 0.5f));
-  for (int y = y0; y <= y1; ++y)
-    for (int x = x0; x <= x1; ++x)
-      obs[y * kW + x] = std::max(obs[y * kW + x], v);
+  int x0 = std::max(0, (int)std::ceil((cx - hw) * kW - 0.5f) - 1);
+  int x1 = std::min(kW - 1, (int)std::floor((cx + hw) * kW - 0.5f) + 1);
+  int y0 = std::max(0, (int)std::ceil((cy - hh) * kH - 0.5f) - 1);
+  int y1 = std::min(kH - 1, (int)std::floor((cy + hh) * kH - 0.5f) + 1);
+  for (int y = y0; y <= y1; ++y) {
+    float Yc = (y + 0.5f) / kH;
+    if (std::fabs(Yc - cy) > hh) continue;
+    for (int x = x0; x <= x1; ++x) {
+      float Xc = (x + 0.5f) / kW;
+      if (std::fabs(Xc - cx) <= hw)
+        obs[y * kW + x] = std::max(obs[y * kW + x], v);
+    }
+  }
 }
 
 class SeaquestEnv : public Env {

@@ -153,13 +153,16 @@ def _build_player_factory(args, cfg: BA3CConfig):
             image_size=cfg.image_size,
         )
     if args.env.startswith("zmq:"):
-        # external env server (e.g. the C++ batched Atari server) already
-        # speaks the simulator wire protocol — there is no in-process player
-        # to build; sims are remote.
+        # external env servers (e.g. remote CppEnvServerProcess fleets)
+        # already speak the simulator wire protocol — there is no in-process
+        # player to build; point the SERVERS at this trainer's tcp:// master
+        # pipes (actors stay host-side over ZMQ even multi-host, SURVEY §2.12)
         raise SystemExit(
-            "--env zmq:<addr>: external env servers connect directly to the "
-            "master pipes; pass their address via --worker_hosts instead of "
-            "--env (see cpp/env_server)"
+            "--env zmq:<addr> is not a player factory: external env servers "
+            "connect TO the master's pipes. Use --env cpp:<game> for local "
+            "native servers, or launch remote env servers pointed at this "
+            "host's c2s/s2c tcp:// endpoints (envs/native.py "
+            "CppEnvServerProcess takes the pipe addresses directly)."
         )
     raise ValueError(f"unknown --env {args.env!r}")
 

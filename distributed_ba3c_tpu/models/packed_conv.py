@@ -1,11 +1,13 @@
 """Lane-packed convolution: fold spatial positions into MXU output lanes.
 
-Why: the reference BA3C net's first two convs have 32 output channels
-(SURVEY.md §2.1 #2). On TPU a conv lowers to an implicit GEMM whose
-output-channel dimension maps onto the MXU's 128 lanes — at 32 channels,
-3/4 of the systolic array idles, capping the whole fused trainer at ~24%
-MFU (measured; PERF.md). This module reformulates a stride-1 SAME conv as
-an equivalent strided conv computing P adjacent output columns per window:
+Status: built to test the hypothesis that the BA3C net's 32-output-channel
+convs underfill the MXU's 128 output lanes. The A/B on v5e came back
+NEUTRAL (fwd 3.74 vs 3.66 us/sample — XLA's conv emitter already packs
+lanes, and the net is HBM-roofline-bound; PERF.md "tested and disproved").
+Kept as exact, gradient-tested infrastructure for backends where the GEMM
+shape does bind; default OFF (``BA3CNet.conv_pack``). The reformulation:
+a stride-1 SAME conv becomes an equivalent strided conv computing P
+adjacent output columns per window:
 
     out[y, P*j+dx, c] = sum_{ky,kx,ci} xpad[y+ky, P*j+dx+kx, ci] * W[ky,kx,ci,c]
 
@@ -14,10 +16,11 @@ Build W'[ky, kx', ci, dx*C+c] = W[ky, kx'-dx, ci, c] (zero outside), then
     out' = conv(xpad, W', window (kh, kw+P-1), strides (1, P), VALID)
 
 has P*C output channels; reshaping [B, H, W/P, P, C] -> [B, H, W, C]
-recovers the exact stride-1 result. Cost: (kw+P-1)/kw more MACs, paid at
-P-fold better lane occupancy — net ~2-2.5x for kw=5, C=32, P in {3,4}
-(measured on v5e; see PERF.md). Everything is differentiable jnp/lax, so
-the backward pass inherits the packing through XLA's conv transposes.
+recovers the exact stride-1 result. Cost: (kw+P-1)/kw more MACs for P-fold
+higher nominal lane occupancy — which the v5e A/B showed does NOT
+translate into time saved there (see Status above). Everything is
+differentiable jnp/lax, so the backward pass inherits the packing through
+XLA's conv transposes.
 
 Parameter names/shapes match ``flax.linen.Conv`` ('kernel' [kh,kw,cin,cout],
 'bias' [cout]) — checkpoints are interchangeable with the plain layer.

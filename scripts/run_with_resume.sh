@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Stall-tolerant training launcher: runs train.py, watches the run's log for
+# progress, and on a stall (no log writes for STALL_SECS — e.g. the tunneled
+# PJRT client losing its terminal mid-run) kills the process and resumes from
+# the run's checkpoints with --load. Training survives infrastructure flakes
+# without operator attention (the reference had no crash-resume beyond manual
+# --load either — SURVEY.md §5 checkpoint/resume).
+#
+# Usage: scripts/run_with_resume.sh LOGDIR MAX_RESTARTS STALL_SECS -- <train.py args...>
+# The train args must include --logdir LOGDIR and NOT --load (the launcher
+# adds --load LOGDIR/checkpoints whenever that directory exists, so re-running
+# the same command over a prior run's logdir RESUMES it, never restarts it).
+set -u
+LOGDIR=$1; MAX_RESTARTS=$2; STALL_SECS=$3; shift 3
+[ "$1" = "--" ] && shift
+HERE=$(cd "$(dirname "$0")/.." && pwd)
+
+attempt=0
+while :; do
+  args=("$@")
+  # resume whenever checkpoints exist — including a FRESH launcher
+  # invocation over a prior run's logdir (restarting from step 0 would
+  # clobber the existing checkpoints)
+  if [ -d "$LOGDIR/checkpoints" ]; then
+    args+=(--load "$LOGDIR/checkpoints")
+  fi
+  echo "[run_with_resume] attempt $attempt: python train.py ${args[*]}" >&2
+  # setsid: own process group, so the stall kill reaps the trainer AND its
+  # spawned children without touching unrelated processes on the machine
+  setsid python "$HERE/train.py" "${args[@]}" &
+  pid=$!
+  start=$(date +%s)
+  # watchdog: poll the log mtime; kill on stall. Progress is measured
+  # against max(attempt start, log mtime) so a stale log from a PREVIOUS
+  # attempt can't kill this one during startup/compile.
+  while kill -0 $pid 2>/dev/null; do
+    sleep 30
+    log="$LOGDIR/log.log"
+    last=$start
+    if [ -f "$log" ]; then
+      m=$(stat -c %Y "$log")
+      [ "$m" -gt "$last" ] && last=$m
+    fi
+    age=$(( $(date +%s) - last ))
+    if [ $age -gt $STALL_SECS ]; then
+      echo "[run_with_resume] stall: no progress for ${age}s — killing group $pid" >&2
+      kill -- -$pid 2>/dev/null; sleep 5; kill -9 -- -$pid 2>/dev/null
+      break
+    fi
+  done
+  wait $pid; rc=$?
+  if [ $rc -eq 0 ]; then
+    echo "[run_with_resume] finished cleanly" >&2
+    exit 0
+  fi
+  attempt=$((attempt + 1))
+  if [ $attempt -gt $MAX_RESTARTS ]; then
+    echo "[run_with_resume] giving up after $MAX_RESTARTS restarts (rc=$rc)" >&2
+    exit $rc
+  fi
+done

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Short fused-trainer learning-curve runs for the remaining env families
+# (BASELINE configs #3/#5 evidence): CoinRun, Seaquest, Q*bert. Each run is
+# ~10 epochs under the stall watchdog; curves land in runs/<game>/stat.json.
+set -u
+HERE=$(cd "$(dirname "$0")/.." && pwd)
+EPOCHS=${EPOCHS:-10}
+for game in coinrun seaquest qbert; do
+  echo "=== $game ===" >&2
+  bash "$HERE/scripts/run_with_resume.sh" "$HERE/runs/$game" 2 240 -- \
+    --trainer tpu_fused_ba3c --env "jax:$game" \
+    --batch_size 20480 --rollout_len 20 --steps_per_epoch 100 \
+    --max_epoch "$EPOCHS" --nr_eval 32 --eval_every 2 --eval_max_steps 3000 \
+    --entropy_beta 0.01 --learning_rate 6e-4 \
+    --logdir "$HERE/runs/$game"
+done
