@@ -31,7 +31,7 @@ import jax
 BASELINE_ENV_STEPS_PER_SEC = 80_000.0  # recalled 64-node cluster rate, UNVERIFIED
 
 
-def bench_fused(n_envs: int = 4096, rollout_len: int = 20, iters: int = 20) -> dict:
+def bench_fused(n_envs: int = 4096, rollout_len: int = 40, iters: int = 10) -> dict:
     from distributed_ba3c_tpu.config import BA3CConfig
     from distributed_ba3c_tpu.envs.jaxenv import pong
     from distributed_ba3c_tpu.fused.loop import create_fused_state, make_fused_step

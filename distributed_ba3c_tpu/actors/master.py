@@ -34,8 +34,12 @@ class BA3CSimulatorMaster(SimulatorMaster):
         train_queue: Optional[queue.Queue] = None,
         score_queue: Optional[queue.Queue] = None,
         actor_timeout: Optional[float] = None,
+        reward_clip: float = 0.0,
     ):
-        super().__init__(pipe_c2s, pipe_s2c, actor_timeout=actor_timeout)
+        super().__init__(
+            pipe_c2s, pipe_s2c, actor_timeout=actor_timeout,
+            reward_clip=reward_clip,
+        )
         self.predictor = predictor
         self.gamma = gamma
         self.local_time_max = local_time_max

@@ -137,13 +137,20 @@ class SimulatorMaster(threading.Thread):
         pipe_c2s: str,
         pipe_s2c: str,
         actor_timeout: Optional[float] = None,
+        reward_clip: float = 0.0,
     ):
         """``actor_timeout``: seconds of silence after which a client's state
         is dropped (failure detection the reference lacked, SURVEY.md §5 —
         a dead simulator would otherwise pin its half-built rollout forever).
-        None disables pruning."""
+        None disables pruning. ``reward_clip``: clip the LEARNING reward to
+        [-c, c] (0 = off); episode scores always accumulate raw rewards."""
         super().__init__(daemon=True, name="SimulatorMaster")
         self.actor_timeout = actor_timeout
+        assert reward_clip >= 0, (
+            f"reward_clip must be >= 0, got {reward_clip} (a negative bound "
+            "would silently map every learning reward to a constant)"
+        )
+        self.reward_clip = reward_clip
         self._last_prune = 0.0
         self.context = zmq.Context()
         self.c2s_socket = self.context.socket(zmq.PULL)
@@ -236,13 +243,19 @@ class SimulatorMaster(threading.Thread):
         """
         client = self.clients[ident]
         if len(client.memory) > 0:
-            client.memory[-1].reward = reward
-            client.score += reward
+            client.memory[-1].reward = self._learn_reward(reward)
+            client.score += reward  # scores stay RAW
             if is_over:
                 self._on_episode_over(ident)
             else:
                 self._on_datapoint(ident)
         self._on_state(state, ident)
+
+    def _learn_reward(self, reward: float) -> float:
+        """The LEARNING reward: clipped to [-c, c] when reward_clip is set
+        (single definition shared by every master subclass)."""
+        c = self.reward_clip
+        return max(-c, min(c, reward)) if c else reward
 
     def send_action(self, ident: bytes, action: int) -> None:
         self.send_queue.put([ident, dumps(int(action))])

@@ -50,8 +50,12 @@ class VTraceSimulatorMaster(SimulatorMaster):
         train_queue: Optional[queue.Queue] = None,
         score_queue: Optional[queue.Queue] = None,
         actor_timeout: Optional[float] = None,
+        reward_clip: float = 0.0,
     ):
-        super().__init__(pipe_c2s, pipe_s2c, actor_timeout=actor_timeout)
+        super().__init__(
+            pipe_c2s, pipe_s2c, actor_timeout=actor_timeout,
+            reward_clip=reward_clip,
+        )
         self.predictor = predictor
         self.unroll_len = unroll_len
         self.queue: queue.Queue = train_queue or queue.Queue(maxsize=1024)
@@ -89,9 +93,9 @@ class VTraceSimulatorMaster(SimulatorMaster):
         client = self.clients[ident]
         if len(client.memory) > 0:
             step = client.memory[-1]
-            step.reward = reward
+            step.reward = self._learn_reward(reward)
             step.done = is_over
-            client.score += reward
+            client.score += reward  # scores stay RAW
             if is_over:
                 self._on_episode_over(ident)
             self._maybe_emit(ident)

@@ -61,6 +61,7 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--frame_history", type=int, default=None)
     p.add_argument("--grad_clip_norm", type=float, default=None)
     p.add_argument("--adam_epsilon", type=float, default=None)
+    p.add_argument("--reward_clip", type=float, default=None, help="clip learning rewards to [-c, c] (0=off); episode scores stay raw")
     # -- loop shape --------------------------------------------------------
     p.add_argument("--steps_per_epoch", type=int, default=1000)
     p.add_argument("--max_epoch", type=int, default=100)
@@ -96,7 +97,7 @@ def build_config(args) -> BA3CConfig:
     for f in (
         "learning_rate entropy_beta gamma batch_size local_time_max "
         "simulator_procs predict_batch_size predictor_threads fc_units "
-        "frame_history grad_clip_norm adam_epsilon"
+        "frame_history grad_clip_norm adam_epsilon reward_clip"
     ).split():
         v = getattr(args, f)
         if v is not None:
@@ -303,6 +304,7 @@ def main(argv: Optional[list] = None) -> int:
             unroll_len=cfg.local_time_max,
             score_queue=score_q,
             actor_timeout=args.actor_timeout or None,
+            reward_clip=cfg.reward_clip,
         )
         # segments per GLOBAL batch: ~batch_size transitions, divisible by
         # the data axis; each host's feed collates only its 1/n_hosts share
@@ -321,6 +323,7 @@ def main(argv: Optional[list] = None) -> int:
             local_time_max=cfg.local_time_max,
             score_queue=score_q,
             actor_timeout=args.actor_timeout or None,
+            reward_clip=cfg.reward_clip,
         )
         if distributed:
             local_batch_slice(cfg.batch_size)  # asserts host divisibility

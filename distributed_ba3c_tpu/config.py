@@ -31,6 +31,9 @@ class BA3CConfig:
     # --- algorithm --------------------------------------------------------
     gamma: float = 0.99                      # GAMMA
     local_time_max: int = 5                  # LOCAL_TIME_MAX (n-step truncation)
+    reward_clip: float = 0.0                 # clip rewards to [-c, c] (0 = off);
+                                             # standard A3C stabilizer for games
+                                             # with multi-scale scores
     entropy_beta: float = 0.01               # entropy bonus coefficient
     value_loss_coef: float = 0.5             # weight on the L2 value loss
     value_huber_delta: float | None = None   # Huber value loss if set (robust)
@@ -49,6 +52,11 @@ class BA3CConfig:
     # --- model ------------------------------------------------------------
     num_actions: int = 6                     # set from the env at build time
     fc_units: int = 512
+
+    def __post_init__(self):
+        assert self.reward_clip >= 0, (
+            f"reward_clip must be >= 0, got {self.reward_clip}"
+        )
 
     @property
     def state_shape(self) -> Tuple[int, int, int]:

@@ -132,13 +132,19 @@ def make_fused_step(
             new_stack = jnp.concatenate(
                 [stack[..., 1:] * keep, obs[..., None]], axis=-1
             )
-            # episode bookkeeping (done ⇒ env auto-restarted inside step)
+            # episode bookkeeping (done ⇒ env auto-restarted inside step);
+            # scores accumulate RAW rewards, the learner sees clipped ones
             ep_ret = ep_ret + reward
             donef = done.astype(jnp.float32)
             ep_sum = ep_sum + ep_ret * donef
             ep_cnt = ep_cnt + done.astype(jnp.int32)
             ep_ret = ep_ret * (1.0 - donef)
-            ys = (stack, actions, reward, donef)
+            r_learn = (
+                jnp.clip(reward, -cfg.reward_clip, cfg.reward_clip)
+                if cfg.reward_clip
+                else reward
+            )
+            ys = (stack, actions, r_learn, donef)
             return (env_state, new_stack, key, ep_ret, ep_cnt, ep_sum), ys
 
         carry0 = (

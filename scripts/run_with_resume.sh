@@ -32,17 +32,23 @@ while :; do
   start=$(date +%s)
   # watchdog: poll the log mtime; kill on stall. Progress is measured
   # against max(attempt start, log mtime) so a stale log from a PREVIOUS
-  # attempt can't kill this one during startup/compile.
+  # attempt can't kill this one, and until THIS attempt's first log write
+  # (startup + XLA compile can exceed STALL_SECS) the threshold gets an
+  # extra 600s of grace.
   while kill -0 $pid 2>/dev/null; do
     sleep 30
     log="$LOGDIR/log.log"
     last=$start
+    thresh=$(( STALL_SECS + 600 ))
     if [ -f "$log" ]; then
       m=$(stat -c %Y "$log")
-      [ "$m" -gt "$last" ] && last=$m
+      if [ "$m" -gt "$last" ]; then
+        last=$m
+        thresh=$STALL_SECS
+      fi
     fi
     age=$(( $(date +%s) - last ))
-    if [ $age -gt $STALL_SECS ]; then
+    if [ $age -gt $thresh ]; then
       echo "[run_with_resume] stall: no progress for ${age}s — killing group $pid" >&2
       kill -- -$pid 2>/dev/null; sleep 5; kill -9 -- -$pid 2>/dev/null
       break

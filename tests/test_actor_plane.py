@@ -81,6 +81,41 @@ def test_parse_memory_truncation_bootstraps_from_value(tmp_path):
     assert len(client.memory) == 1 and client.memory[0].value == 4.0
 
 
+def test_reward_clip_applies_to_learning_not_scores(tmp_path):
+    """reward_clip bounds the learner's rewards via the REAL message path;
+    episode scores stay raw."""
+
+    class _NoPredictMaster(BA3CSimulatorMaster):
+        def _on_state(self, state, ident):  # skip the predictor round-trip
+            pass
+
+    score_q = queue.Queue()
+    m = _NoPredictMaster(
+        f"ipc://{tmp_path}/c",
+        f"ipc://{tmp_path}/s",
+        _NullPredictor(),
+        gamma=0.0,
+        local_time_max=3,
+        score_queue=score_q,
+        reward_clip=1.0,
+    )
+    ident = b"sim-9"
+    client = m.clients[ident]
+    client.ident = ident
+    client.memory.append(
+        TransitionExperience(np.zeros((2, 2), np.uint8), 0, value=0.0)
+    )
+    # a +25 reward arrives with episode end: the base _on_message attaches
+    # the clipped learning reward and accumulates the raw score
+    try:
+        m._on_message(ident, np.zeros((2, 2), np.uint8), 25.0, True)
+        _, _, R = m.queue.get_nowait()
+        assert R == 1.0  # clipped learning signal
+        assert score_q.get_nowait() == 25.0  # raw episode score
+    finally:
+        m.close()  # never leak the ZMQ context/threads into later tests
+
+
 def test_zmq_actor_plane_end_to_end(tmp_path):
     """2 FakeEnv simulator processes stream through a real predictor; the
     train queue fills with well-formed n-step datapoints."""

@@ -174,10 +174,12 @@ def test_cpp_player_protocol():
     assert p.get_action_space_size() == 6
 
 
+@pytest.mark.timeout(600)
 def test_cpp_env_server_speaks_wire_protocol(tmp_path):
-    """The server process is indistinguishable from B SimulatorProcesses."""
-    import queue as _q
+    """The server process is indistinguishable from B SimulatorProcesses.
 
+    Generous timeouts: under a fully loaded suite the spawned server can
+    take minutes to start (process spawn + import contention)."""
     import zmq
 
     from distributed_ba3c_tpu.utils.serialize import dumps, loads
@@ -186,6 +188,7 @@ def test_cpp_env_server_speaks_wire_protocol(tmp_path):
     s2c = f"ipc://{tmp_path}/s2c"
     ctx = zmq.Context()
     pull = ctx.socket(zmq.PULL)
+    pull.setsockopt(zmq.RCVTIMEO, 300_000)
     pull.bind(c2s)
     router = ctx.socket(zmq.ROUTER)
     router.bind(s2c)
