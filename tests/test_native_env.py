@@ -184,22 +184,40 @@ def test_cpp_env_server_speaks_wire_protocol(tmp_path):
 
     from distributed_ba3c_tpu.utils.serialize import dumps, loads
 
+    import time
+
     c2s = f"ipc://{tmp_path}/c2s"
     s2c = f"ipc://{tmp_path}/s2c"
     ctx = zmq.Context()
     pull = ctx.socket(zmq.PULL)
-    pull.setsockopt(zmq.RCVTIMEO, 300_000)
+    pull.setsockopt(zmq.RCVTIMEO, 10_000)
     pull.bind(c2s)
     router = ctx.socket(zmq.ROUTER)
     router.bind(s2c)
 
     proc = native.CppEnvServerProcess(0, c2s, s2c, game="pong", n_envs=3)
     proc.start()
+
+    def recv_with_liveness(deadline):
+        """Poll-recv so a dead/stuck server fails with a DIAGNOSIS, not a
+        bare timeout (this test has flaked under full-suite load)."""
+        while True:
+            try:
+                return loads(pull.recv())
+            except zmq.Again:
+                assert proc.is_alive(), (
+                    f"env server died, exitcode={proc.exitcode}"
+                )
+                assert time.time() < deadline, (
+                    "env server alive but silent past the deadline"
+                )
+
     try:
+        deadline = time.time() + 550  # startup under load can take minutes
         seen = {}
         for round_ in range(3):
             for _ in range(3):
-                ident, state, reward, is_over = loads(pull.recv())
+                ident, state, reward, is_over = recv_with_liveness(deadline)
                 assert state.shape == (84, 84, 4) and state.dtype == np.uint8
                 seen[ident] = seen.get(ident, 0) + 1
                 router.send_multipart([ident, dumps(0)])
