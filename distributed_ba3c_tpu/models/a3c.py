@@ -36,6 +36,54 @@ class PolicyValue(NamedTuple):
     value: jax.Array   # [B] float32
 
 
+def _pallas_ok(x: jax.Array, features: int, k: int, pooled: bool) -> bool:
+    """Geometry the fused Pallas block can compile (ops/pallas_conv.py)."""
+    from distributed_ba3c_tpu.ops.pallas_conv import ConvSpec, supported
+
+    s = ConvSpec(
+        H=x.shape[1], W=x.shape[2], Ci=x.shape[3], Co=features,
+        kh=k, kw=k, pool=pooled, scale_uint8=False,
+    )
+    return supported(s)
+
+
+class _PallasConvBlock(nn.Module):
+    """conv+bias+relu(+2x2 maxpool) as one fused Pallas kernel.
+
+    Param names/shapes match ``nn.Conv`` ('kernel' [k,k,ci,co], 'bias'
+    [co]); interpret mode is selected automatically off-TPU so tests run
+    on the CPU backend.
+    """
+
+    features: int
+    kernel_size: int
+    pool: bool
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from distributed_ba3c_tpu.ops.pallas_conv import ConvSpec, conv_block
+
+        B, H, W, Ci = x.shape
+        k = self.kernel_size
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (k, k, Ci, self.features), jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.features,), jnp.float32
+        )
+        s = ConvSpec(
+            H=H, W=W, Ci=Ci, Co=self.features, kh=k, kw=k,
+            pool=self.pool, scale_uint8=False,
+        )
+        y = conv_block(
+            x.astype(jnp.bfloat16).reshape(B, H, W * Ci),
+            kernel, bias, s,
+            jax.default_backend() != "tpu",
+        )
+        return y.reshape(B, s.Ho, s.Wo, self.features)
+
+
 class BA3CNet(nn.Module):
     """Policy/value network with the reference's conv stack."""
 
@@ -52,6 +100,14 @@ class BA3CNet(nn.Module):
     # for backends where the GEMM shape does bind. 0/1 = plain nn.Conv.
     # Numerically EXACT either way (value- and gradient-tested).
     conv_pack: Tuple[int, ...] = (0, 0, 0, 0)
+    # "xla" (default) or "pallas": fused Pallas conv+relu+pool blocks where
+    # the geometry allows (ops/pallas_conv.py — blocks whose P*Ci is a
+    # 128-multiple, i.e. the 32/64-channel layers; conv0's Ci=4 cannot).
+    # MEASURED SLOWER on the v5e (patch-assembly relayout outweighs the 4x
+    # MXU lane-occupancy win — PERF.md), so the default stays XLA; kept as
+    # value- and gradient-tested kernel infrastructure. Checkpoints are
+    # interchangeable (same param names/shapes).
+    conv_backend: str = "xla"
 
     @nn.compact
     def __call__(self, state: jax.Array) -> PolicyValue:
@@ -70,9 +126,21 @@ class BA3CNet(nn.Module):
                 strict=True,
             )
         ):
-            # explicit name "Conv_i" for BOTH branches: PackedConv owns
-            # nn.Conv-shaped params, so checkpoints stay interchangeable
-            # between packed and plain configurations
+            # explicit name "Conv_i" for ALL branches: PackedConv and
+            # _PallasConvBlock own nn.Conv-shaped params, so checkpoints
+            # stay interchangeable between configurations
+            # the Pallas block is bf16-only; any other compute dtype must
+            # use the XLA path to honor the requested precision
+            if (
+                self.conv_backend == "pallas"
+                and self.compute_dtype == jnp.bfloat16
+                and _pallas_ok(x, feats, k, pooled)
+            ):
+                x = _PallasConvBlock(
+                    features=feats, kernel_size=k, pool=pooled,
+                    name=f"Conv_{i}",
+                )(x)
+                continue  # relu+pool fused inside the block
             if pack and pack > 1:
                 from distributed_ba3c_tpu.models.packed_conv import PackedConv
 
