@@ -69,6 +69,46 @@ constexpr float kCubePoints = 25.f, kClearBonus = 100.f;
 constexpr int kLives = 3, kMaxT = 2000, kNumActions = 5;
 }  // namespace qb
 
+// ------------------------------------------------------ Space Invaders ----
+// Mirrors distributed_ba3c_tpu/envs/jaxenv/space_invaders.py: 6x6 fleet,
+// row-scored 30..5, one player shot, alien bombs, 3 lives.
+namespace si {
+constexpr int kRows = 6, kCols = 6;
+constexpr float kAlienW = 0.07f, kAlienH = 0.03f;
+constexpr float kGridDX = 0.11f, kGridDY = 0.07f;
+constexpr float kMarch = 0.004f, kDescend = 0.05f;
+constexpr float kPlayerY = 0.93f, kPlayerW = 0.05f, kPlayerSpeed = 0.03f;
+constexpr float kShotSpeed = 0.05f, kBombSpeed = 0.025f, kBombP = 0.06f;
+constexpr int kNBombs = 3, kLives = 3, kMaxT = 10000, kNumActions = 6;
+constexpr float kRowPoints[kRows] = {30.f, 25.f, 20.f, 15.f, 10.f, 5.f};
+}  // namespace si
+
+// -------------------------------------------------------------- Boxing ----
+// Mirrors distributed_ba3c_tpu/envs/jaxenv/boxing.py: +1/-1 per punch
+// landed/taken, KO at 100, pursuing scripted opponent, 18 actions.
+namespace bx {
+constexpr float kRingLo = 0.08f, kRingHi = 0.92f;
+constexpr float kMove = 0.022f, kOppMove = 0.014f;
+constexpr float kPunchRange = 0.10f, kOppPunchP = 0.25f;
+constexpr int kPunchCd = 4, kKo = 100, kMaxT = 2000, kNumActions = 18;
+}  // namespace bx
+
+// ------------------------------------------------------------- Assault ----
+// Mirrors distributed_ba3c_tpu/envs/jaxenv/assault.py: mothership + 3
+// attacker lanes, 21-point quanta, cannon heat/jam, 4 lives, 7 actions.
+namespace as_ {
+constexpr int kNLanes = 3;
+constexpr float kLaneX[kNLanes] = {0.25f, 0.5f, 0.75f};
+constexpr float kMotherY = 0.08f, kMotherW = 0.10f, kMotherSpeed = 0.006f;
+constexpr float kAttW = 0.035f, kAttH = 0.025f;
+constexpr float kDescend = 0.008f, kStrafe = 0.006f, kSpawnP = 0.08f;
+constexpr float kPlayerY = 0.93f, kPlayerW = 0.05f, kPlayerSpeed = 0.03f;
+constexpr float kShotSpeed = 0.06f, kBombSpeed = 0.02f, kBombP = 0.04f;
+constexpr float kHeatPerShot = 0.45f, kCool = 0.015f, kVentCool = 0.12f;
+constexpr int kLives = 4, kMaxT = 10000, kNumActions = 7;
+constexpr float kAttackerPoints = 21.f, kMotherPoints = 42.f;
+}  // namespace as_
+
 // ------------------------------------------------------------ Breakout ----
 namespace brk {
 constexpr int kRows = 6, kCols = 18;
@@ -595,6 +635,423 @@ class QbertEnv : public Env {
   int lives_, boards_, t_;
 };
 
+class SpaceInvadersEnv : public Env {
+ public:
+  explicit SpaceInvadersEnv(uint64_t seed) : rng_(seed) { Reset(); }
+
+  void Reset() override {
+    namespace S = si;
+    for (auto& a : aliens_) a = true;
+    ox_ = 0.18f;
+    oy_ = 0.12f;
+    dir_ = 1.f;
+    player_x_ = 0.5f;
+    shot_live_ = false;
+    shot_x_ = shot_y_ = 0.f;
+    for (int i = 0; i < S::kNBombs; ++i) bomb_live_[i] = false;
+    lives_ = S::kLives;
+    t_ = 0;
+  }
+
+  StepOut Step(int action) override {
+    StepOut out;
+    for (int i = 0; i < kFrameSkip; ++i) out.reward += Substep(action);
+    ++t_;
+    if (lives_ <= 0 || t_ >= si::kMaxT) {
+      out.done = true;
+      Reset();
+    }
+    return out;
+  }
+
+  void Render(uint8_t* obs) const override {
+    namespace S = si;
+    std::memset(obs, 0, kH * kW);
+    for (int r = 0; r < S::kRows; ++r)
+      for (int c = 0; c < S::kCols; ++c)
+        if (aliens_[r * S::kCols + c])
+          MaxRect(obs, ox_ + c * S::kGridDX, oy_ + r * S::kGridDY,
+                  S::kAlienW, S::kAlienH, 180);
+    MaxRect(obs, player_x_, S::kPlayerY, S::kPlayerW, 0.02f, 255);
+    if (shot_live_) MaxRect(obs, shot_x_, shot_y_, 0.006f, 0.015f, 255);
+    for (int i = 0; i < S::kNBombs; ++i)
+      if (bomb_live_[i])
+        MaxRect(obs, bomb_x_[i], bomb_y_[i], 0.006f, 0.015f, 120);
+  }
+
+  int NumActions() const override { return si::kNumActions; }
+
+ private:
+  float Substep(int action) {
+    namespace S = si;
+    // 0 noop, 1 fire, 2 right, 3 left, 4 right+fire, 5 left+fire
+    float move = (action == 2 || action == 4) ? 1.f
+                 : (action == 3 || action == 5) ? -1.f : 0.f;
+    bool fire = action == 1 || action == 4 || action == 5;
+    player_x_ = std::clamp(player_x_ + move * S::kPlayerSpeed, S::kPlayerW,
+                           1.f - S::kPlayerW);
+
+    // march: faster as the fleet thins
+    int alive = 0;
+    for (bool a : aliens_) alive += a;
+    float speed =
+        S::kMarch * (1.f + 2.f * (1.f - (float)alive / (S::kRows * S::kCols)));
+    float left = 1e9f, right = -1e9f;
+    for (int c = 0; c < S::kCols; ++c) {
+      bool any = false;
+      for (int r = 0; r < S::kRows; ++r) any = any || aliens_[r * S::kCols + c];
+      if (any) {
+        left = std::min(left, ox_ + c * S::kGridDX);
+        right = std::max(right, ox_ + c * S::kGridDX);
+      }
+    }
+    bool edge = (right + S::kAlienW >= 0.98f && dir_ > 0) ||
+                (left - S::kAlienW <= 0.02f && dir_ < 0);
+    if (edge) {
+      dir_ = -dir_;
+      oy_ += S::kDescend;
+    } else {
+      ox_ += speed * dir_;
+    }
+
+    // player shot
+    bool launch = fire && !shot_live_;
+    if (launch) {
+      shot_x_ = player_x_;
+      shot_y_ = S::kPlayerY - 0.03f;
+    }
+    if (shot_live_ || launch) shot_y_ -= S::kShotSpeed;
+    shot_live_ = (shot_live_ || launch) && shot_y_ > 0.f;
+
+    // shot vs fleet (nearest cell, same rule as the jnp argmin lookup)
+    float reward = 0.f;
+    if (shot_live_) {
+      int col = (int)std::lround((shot_x_ - ox_) / S::kGridDX);
+      int row = (int)std::lround((shot_y_ - oy_) / S::kGridDY);
+      col = std::clamp(col, 0, S::kCols - 1);
+      row = std::clamp(row, 0, S::kRows - 1);
+      bool in = std::fabs(ox_ + col * S::kGridDX - shot_x_) <= S::kAlienW &&
+                std::fabs(oy_ + row * S::kGridDY - shot_y_) <= S::kAlienH;
+      if (in && aliens_[row * S::kCols + col]) {
+        aliens_[row * S::kCols + col] = false;
+        reward += S::kRowPoints[row];
+        shot_live_ = false;
+      }
+    }
+
+    // bombs from the lowest live alien of a random column
+    std::uniform_real_distribution<float> uni(0.f, 1.f);
+    int bcol = (int)(rng_() % S::kCols);
+    int low = -1;
+    for (int r = S::kRows - 1; r >= 0; --r)
+      if (aliens_[r * S::kCols + bcol]) {
+        low = r;
+        break;
+      }
+    int slot = -1;
+    for (int i = 0; i < S::kNBombs; ++i)
+      if (!bomb_live_[i]) {
+        slot = i;
+        break;
+      }
+    if (low >= 0 && slot >= 0 && uni(rng_) < S::kBombP) {
+      bomb_live_[slot] = true;
+      bomb_x_[slot] = ox_ + bcol * S::kGridDX;
+      bomb_y_[slot] = oy_ + low * S::kGridDY + S::kAlienH;
+    }
+    // at most one life lost per substep, as in the jnp any() reduction
+    bool any_hit = false;
+    for (int i = 0; i < S::kNBombs; ++i) {
+      if (!bomb_live_[i]) continue;
+      bomb_y_[i] += S::kBombSpeed;
+      bool hit = std::fabs(bomb_x_[i] - player_x_) <= S::kPlayerW &&
+                 bomb_y_[i] >= S::kPlayerY - 0.02f;
+      if (hit) {
+        any_hit = true;
+        bomb_live_[i] = false;
+      } else if (bomb_y_[i] >= 1.f) {
+        bomb_live_[i] = false;
+      }
+    }
+    if (any_hit) --lives_;
+
+    // fleet landed -> game over; wave cleared -> fresh, lower fleet
+    for (int r = 0; r < S::kRows; ++r)
+      for (int c = 0; c < S::kCols; ++c)
+        if (aliens_[r * S::kCols + c] &&
+            oy_ + r * S::kGridDY + S::kAlienH >= S::kPlayerY - 0.02f)
+          lives_ = 0;
+    bool any = false;
+    for (bool a : aliens_) any = any || a;
+    if (!any) {
+      for (auto& a : aliens_) a = true;
+      ox_ = 0.18f;
+      oy_ = 0.16f;
+    }
+    return reward;
+  }
+
+  std::mt19937_64 rng_;
+  bool aliens_[si::kRows * si::kCols];
+  float ox_, oy_, dir_, player_x_;
+  float shot_x_, shot_y_;
+  bool shot_live_;
+  float bomb_x_[si::kNBombs], bomb_y_[si::kNBombs];
+  bool bomb_live_[si::kNBombs];
+  int lives_, t_;
+};
+
+class BoxingEnv : public Env {
+ public:
+  explicit BoxingEnv(uint64_t seed) : rng_(seed) { Reset(); }
+
+  void Reset() override {
+    me_x_ = 0.3f;
+    me_y_ = 0.5f;
+    op_x_ = 0.7f;
+    op_y_ = 0.5f;
+    my_score_ = op_score_ = 0;
+    my_cd_ = op_cd_ = 0;
+    t_ = 0;
+  }
+
+  StepOut Step(int action) override {
+    namespace B = bx;
+    StepOut out;
+    for (int i = 0; i < kFrameSkip; ++i) out.reward += Substep(action);
+    ++t_;
+    if (my_score_ >= B::kKo || op_score_ >= B::kKo || t_ >= B::kMaxT) {
+      out.done = true;
+      Reset();
+    }
+    return out;
+  }
+
+  void Render(uint8_t* obs) const override {
+    namespace B = bx;
+    std::memset(obs, 0, kH * kW);
+    for (int y = 0; y < kH; ++y)
+      for (int x = 0; x < kW; ++x) {
+        float Xc = (x + 0.5f) / kW, Yc = (y + 0.5f) / kH;
+        if (std::fabs(Xc - B::kRingLo) < 0.008f ||
+            std::fabs(Xc - B::kRingHi) < 0.008f ||
+            std::fabs(Yc - B::kRingLo) < 0.008f ||
+            std::fabs(Yc - B::kRingHi) < 0.008f)
+          obs[y * kW + x] = 80;
+        if (Yc < 0.04f && Xc < (float)my_score_ / B::kKo)
+          obs[y * kW + x] = 255;
+        if (Yc > 0.96f && Xc < (float)op_score_ / B::kKo)
+          obs[y * kW + x] = std::max<uint8_t>(obs[y * kW + x], 120);
+      }
+    MaxRect(obs, op_x_, op_y_, 0.03f, 0.03f, 150);
+    MaxRect(obs, me_x_, me_y_, 0.03f, 0.03f, 255);
+  }
+
+  int NumActions() const override { return bx::kNumActions; }
+
+ private:
+  float Substep(int action) {
+    namespace B = bx;
+    // decode: 1 punch; 2..9 moves/diagonals; 10..17 punch+move
+    static const float mv[10][2] = {{0, 0}, {0, 0},  {0, -1}, {1, 0}, {-1, 0},
+                                    {0, 1}, {1, -1}, {-1, -1}, {1, 1}, {-1, 1}};
+    bool combo = action >= 10;
+    int base = std::clamp(combo ? action - 8 : action, 0, 9);
+    bool punch = action == 1 || combo;
+    me_x_ = std::clamp(me_x_ + mv[base][0] * B::kMove, B::kRingLo, B::kRingHi);
+    me_y_ = std::clamp(me_y_ + mv[base][1] * B::kMove, B::kRingLo, B::kRingHi);
+
+    std::uniform_real_distribution<float> uni(0.f, 1.f);
+    float dx = me_x_ - op_x_, dy = me_y_ - op_y_;
+    float dist = std::sqrt(dx * dx + dy * dy) + 1e-6f;
+    op_x_ += dx / dist * B::kOppMove + (uni(rng_) - 0.5f) * B::kOppMove;
+    op_y_ += dy / dist * B::kOppMove + (uni(rng_) - 0.5f) * B::kOppMove;
+    op_x_ = std::clamp(op_x_, B::kRingLo, B::kRingHi);
+    op_y_ = std::clamp(op_y_, B::kRingLo, B::kRingHi);
+
+    bool in_range = dist <= B::kPunchRange;
+    bool my_land = punch && in_range && my_cd_ <= 0;
+    bool op_land = uni(rng_) < B::kOppPunchP && in_range && op_cd_ <= 0;
+    // knockback pushes the punched boxer AWAY from the puncher (dx = me-op)
+    if (my_land) {
+      ++my_score_;
+      op_x_ = std::clamp(op_x_ + dx / dist * -0.05f, B::kRingLo, B::kRingHi);
+      op_y_ = std::clamp(op_y_ + dy / dist * -0.05f, B::kRingLo, B::kRingHi);
+    }
+    if (op_land) {
+      ++op_score_;
+      me_x_ = std::clamp(me_x_ + dx / dist * 0.05f, B::kRingLo, B::kRingHi);
+      me_y_ = std::clamp(me_y_ + dy / dist * 0.05f, B::kRingLo, B::kRingHi);
+    }
+    my_cd_ = my_land ? B::kPunchCd : std::max(my_cd_ - 1, 0);
+    op_cd_ = op_land ? B::kPunchCd : std::max(op_cd_ - 1, 0);
+    return (float)my_land - (float)op_land;
+  }
+
+  std::mt19937_64 rng_;
+  float me_x_, me_y_, op_x_, op_y_;
+  int my_score_, op_score_, my_cd_, op_cd_, t_;
+};
+
+class AssaultEnv : public Env {
+ public:
+  explicit AssaultEnv(uint64_t seed) : rng_(seed) { Reset(); }
+
+  void Reset() override {
+    namespace A = as_;
+    mother_x_ = 0.5f;
+    mother_dir_ = 1.f;
+    for (int i = 0; i < A::kNLanes; ++i) att_live_[i] = false;
+    bomb_live_ = false;
+    player_x_ = 0.5f;
+    shot_live_ = false;
+    heat_ = 0.f;
+    jammed_ = false;
+    lives_ = A::kLives;
+    t_ = 0;
+  }
+
+  StepOut Step(int action) override {
+    StepOut out;
+    for (int i = 0; i < kFrameSkip; ++i) out.reward += Substep(action);
+    ++t_;
+    if (lives_ <= 0 || t_ >= as_::kMaxT) {
+      out.done = true;
+      Reset();
+    }
+    return out;
+  }
+
+  void Render(uint8_t* obs) const override {
+    namespace A = as_;
+    std::memset(obs, 0, kH * kW);
+    MaxRect(obs, mother_x_, A::kMotherY, A::kMotherW, 0.02f, 200);
+    for (int i = 0; i < A::kNLanes; ++i)
+      if (att_live_[i])
+        MaxRect(obs, att_x_[i], att_y_[i], A::kAttW, A::kAttH, 160);
+    MaxRect(obs, player_x_, A::kPlayerY, A::kPlayerW, 0.02f, 255);
+    if (shot_live_) MaxRect(obs, shot_x_, shot_y_, 0.006f, 0.015f, 255);
+    if (bomb_live_) MaxRect(obs, bomb_x_, bomb_y_, 0.008f, 0.012f, 120);
+    for (int y = 0; y < kH; ++y) {  // heat gauge on the right edge
+      float Yc = (y + 0.5f) / kH;
+      if (Yc <= 1.f - heat_) continue;
+      for (int x = 0; x < kW; ++x)
+        if ((x + 0.5f) / kW > 0.97f)
+          obs[y * kW + x] = std::max<uint8_t>(obs[y * kW + x], 90);
+    }
+  }
+
+  int NumActions() const override { return as_::kNumActions; }
+
+ private:
+  float Substep(int action) {
+    namespace A = as_;
+    // 0 noop, 1 fire, 2 vent, 3 right, 4 left, 5 right+fire, 6 left+fire
+    float move = (action == 3 || action == 5) ? 1.f
+                 : (action == 4 || action == 6) ? -1.f : 0.f;
+    bool fire = action == 1 || action == 5 || action == 6;
+    bool vent = action == 2;
+    player_x_ = std::clamp(player_x_ + move * A::kPlayerSpeed, A::kPlayerW,
+                           1.f - A::kPlayerW);
+
+    mother_x_ += mother_dir_ * A::kMotherSpeed;
+    if (mother_x_ > 1.f - A::kMotherW || mother_x_ < A::kMotherW)
+      mother_dir_ = -mother_dir_;
+    mother_x_ = std::clamp(mother_x_, A::kMotherW, 1.f - A::kMotherW);
+
+    std::uniform_real_distribution<float> uni(0.f, 1.f);
+    int lane = (int)(rng_() % A::kNLanes);
+    if (!att_live_[lane] && uni(rng_) < A::kSpawnP) {
+      att_live_[lane] = true;
+      att_x_[lane] = mother_x_;
+      att_y_[lane] = A::kMotherY + 0.05f;
+    }
+    for (int i = 0; i < A::kNLanes; ++i) {
+      if (!att_live_[i]) continue;
+      att_x_[i] += (player_x_ > att_x_[i] ? 1.f : -1.f) * A::kStrafe;
+      att_y_[i] += A::kDescend;
+    }
+
+    heat_ = std::max(heat_ - (vent ? A::kVentCool : A::kCool), 0.f);
+    jammed_ = jammed_ && heat_ > 0.3f;
+    bool can_fire = fire && !shot_live_ && !jammed_;
+    if (can_fire) {
+      heat_ += A::kHeatPerShot;
+      shot_x_ = player_x_;
+      shot_y_ = A::kPlayerY - 0.03f;
+    }
+    if (heat_ >= 1.f) jammed_ = true;
+    heat_ = std::min(heat_, 1.f);
+    if (shot_live_ || can_fire) shot_y_ -= A::kShotSpeed;
+    shot_live_ = (shot_live_ || can_fire) && shot_y_ > 0.f;
+
+    // the shot destroys EVERY overlapping attacker (jnp evaluates all hit
+    // flags against the still-live shot, then consumes it once)
+    float reward = 0.f;
+    bool shot_hit = false;
+    for (int i = 0; i < A::kNLanes; ++i) {
+      bool hit = att_live_[i] && shot_live_ &&
+                 std::fabs(att_x_[i] - shot_x_) <= A::kAttW &&
+                 std::fabs(att_y_[i] - shot_y_) <= A::kAttH;
+      if (hit) {
+        reward += A::kAttackerPoints;
+        att_live_[i] = false;
+        shot_hit = true;
+      }
+    }
+    if (shot_hit) shot_live_ = false;
+    if (shot_live_ && std::fabs(mother_x_ - shot_x_) <= A::kMotherW &&
+        shot_y_ <= A::kMotherY + 0.02f) {
+      reward += A::kMotherPoints;
+      shot_live_ = false;
+    }
+
+    int src = -1;
+    for (int i = 0; i < A::kNLanes; ++i)
+      if (att_live_[i]) {
+        src = i;
+        break;
+      }
+    if (!bomb_live_ && src >= 0 && uni(rng_) < A::kBombP) {
+      bomb_live_ = true;
+      bomb_x_ = att_x_[src];
+      bomb_y_ = att_y_[src];
+    }
+    // at most one life lost per substep (jnp: bomb_hit | reached.any())
+    bool player_hit = false;
+    if (bomb_live_) {
+      bomb_y_ += A::kBombSpeed;
+      bool hit = std::fabs(bomb_x_ - player_x_) <= A::kPlayerW &&
+                 bomb_y_ >= A::kPlayerY - 0.02f;
+      if (hit) {
+        player_hit = true;
+        bomb_live_ = false;
+      } else if (bomb_y_ >= 1.f) {
+        bomb_live_ = false;
+      }
+    }
+    for (int i = 0; i < A::kNLanes; ++i)
+      if (att_live_[i] && att_y_[i] >= A::kPlayerY - 0.02f) {
+        player_hit = true;
+        att_live_[i] = false;
+      }
+    if (player_hit) --lives_;
+    return reward;
+  }
+
+  std::mt19937_64 rng_;
+  float mother_x_, mother_dir_;
+  float att_x_[as_::kNLanes], att_y_[as_::kNLanes];
+  bool att_live_[as_::kNLanes];
+  float bomb_x_, bomb_y_;
+  bool bomb_live_;
+  float player_x_, shot_x_, shot_y_;
+  bool shot_live_;
+  float heat_;
+  bool jammed_;
+  int lives_, t_;
+};
+
 // ------------------------------------------------------------- batched ----
 class BatchedEnv {
  public:
@@ -608,6 +1065,12 @@ class BatchedEnv {
         envs_.emplace_back(new SeaquestEnv(seed + i));
       else if (name == "qbert")
         envs_.emplace_back(new QbertEnv(seed + i));
+      else if (name == "space_invaders")
+        envs_.emplace_back(new SpaceInvadersEnv(seed + i));
+      else if (name == "boxing")
+        envs_.emplace_back(new BoxingEnv(seed + i));
+      else if (name == "assault")
+        envs_.emplace_back(new AssaultEnv(seed + i));
       else
         envs_.clear();
       if (envs_.empty()) break;

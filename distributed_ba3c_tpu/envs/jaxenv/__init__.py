@@ -14,7 +14,16 @@ Episodes auto-restart on done (same contract as the host player protocol,
 envs/base.py) so rollout scans never branch.
 """
 
-from distributed_ba3c_tpu.envs.jaxenv import breakout, coinrun, pong, qbert, seaquest
+from distributed_ba3c_tpu.envs.jaxenv import (
+    assault,
+    boxing,
+    breakout,
+    coinrun,
+    pong,
+    qbert,
+    seaquest,
+    space_invaders,
+)
 
 
 def get_env(name: str):
@@ -24,6 +33,9 @@ def get_env(name: str):
         "seaquest": seaquest,
         "qbert": qbert,
         "coinrun": coinrun,
+        "space_invaders": space_invaders,
+        "boxing": boxing,
+        "assault": assault,
     }
     if name not in envs:
         raise ValueError(f"unknown jax env {name!r}; have {sorted(envs)}")

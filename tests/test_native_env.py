@@ -25,14 +25,54 @@ def test_create_and_metadata():
 
 
 def test_action_space_parity_with_jaxenv():
-    """Atari-4 parity: the C++ core and the on-device JAX envs must agree on
-    the action maps so policies transfer between planes."""
+    """Full-gameset parity: the C++ core and the on-device JAX envs must
+    agree on the action maps so policies transfer between planes."""
     jaxenv = pytest.importorskip("distributed_ba3c_tpu.envs.jaxenv")
-    for name in ("pong", "breakout", "seaquest", "qbert"):
+    for name in (
+        "pong", "breakout", "seaquest", "qbert",
+        "space_invaders", "boxing", "assault",
+    ):
         assert (
             native.CppBatchedEnv(name, 1).num_actions
             == jaxenv.get_env(name).num_actions
         ), name
+
+
+def test_gameset_cpp_semantics():
+    """Space Invaders / Boxing / Assault C++ mirrors: reward structure
+    invariants matching their jaxenv counterparts."""
+    rng = np.random.default_rng(0)
+    # space invaders: fire-heavy play scores in row-point quanta (5..30)
+    env = native.CppBatchedEnv("space_invaders", 4, seed=7)
+    env.reset()
+    total = 0.0
+    for _ in range(300):
+        a = rng.choice([1, 1, 2, 3, 4, 5], size=4).astype(np.int32)
+        _, rew, _ = env.step(a)
+        total += float(rew.sum())
+    assert total > 0.0 and total % 5.0 == 0.0
+
+    # assault: 21-point quanta
+    env = native.CppBatchedEnv("assault", 4, seed=8)
+    env.reset()
+    total = 0.0
+    for _ in range(400):
+        a = rng.choice([1, 1, 3, 4, 5, 6, 2], size=4).astype(np.int32)
+        _, rew, _ = env.step(a)
+        total += float(rew.sum())
+    assert total > 0.0 and total % 21.0 == 0.0
+
+    # boxing: rewards are per-punch units in [-4, 4] per agent step, and the
+    # tuned opponent keeps aggressive random play near break-even
+    env = native.CppBatchedEnv("boxing", 4, seed=9)
+    env.reset()
+    total = 0.0
+    for _ in range(500):
+        a = rng.integers(0, 18, size=4).astype(np.int32)
+        _, rew, _ = env.step(a)
+        assert (np.abs(rew) <= 4.0).all()
+        total += float(rew.sum())
+    assert abs(total) / (4 * 500) < 0.5  # near break-even per step
 
 
 def test_seaquest_oxygen_and_lives():
