@@ -191,8 +191,11 @@ class SimulatorMaster(threading.Thread):
 
         try:
             while not self._stop_evt.is_set():
+                # prune on EVERY iteration (it self-rate-limits): gating it
+                # on poll timeouts would starve pruning exactly when the
+                # surviving actors keep the socket busy
+                self._prune_dead_actors()
                 if not poller.poll(timeout=200):
-                    self._prune_dead_actors()
                     continue
                 ident, state, reward, is_over = loads(self.c2s_socket.recv())
                 client = self.clients[ident]

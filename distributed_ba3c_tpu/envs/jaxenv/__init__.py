@@ -12,6 +12,14 @@ Env functional protocol (unbatched; vmap at the call site):
     env.num_actions: int
 Episodes auto-restart on done (same contract as the host player protocol,
 envs/base.py) so rollout scans never branch.
+
+Env-authoring rule (measured, v5e): NO per-env dynamic scalar indexing —
+``grid[row, col]``, ``centers[idx]``, ``.at[slot].set`` with traced scalars
+become batched dynamic gathers/scatters under vmap and ran the WHOLE fused
+step 6x slower (space_invaders, before the rewrite). Use one-hot masks,
+uniform-grid arithmetic, or separable mask matmuls instead; gathers with
+STATE-INDEPENDENT (constant) index arrays are fine (breakout's brick
+raster).
 """
 
 from distributed_ba3c_tpu.envs.jaxenv import (

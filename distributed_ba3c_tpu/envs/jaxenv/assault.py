@@ -151,14 +151,16 @@ def _substep(state: State, move, fire, vent, key: jax.Array):
     reward = reward + jnp.where(hit_mom, MOTHER_POINTS, 0.0)
     shot_live = shot_live & ~hit_mom
 
-    # bombs from a random live attacker
-    bsrc = jnp.argmax(att_live)
+    # bombs from a random live attacker (one-hot contraction, not
+    # att_pos[bsrc]: per-env scalar gathers are pathological under vmap)
+    src_oh = (jnp.arange(N_LANES) == jnp.argmax(att_live)).astype(jnp.float32)
+    src_pos = (att_pos * src_oh[:, None]).sum(axis=0)
     drop = (
         (jax.random.uniform(k_bomb) < BOMB_P)
         & att_live.any()
         & ~state.bomb_live
     )
-    bomb = jnp.where(drop, att_pos[bsrc], state.bomb)
+    bomb = jnp.where(drop, src_pos, state.bomb)
     bomb = bomb.at[1].add(jnp.where(state.bomb_live | drop, BOMB_SPEED, 0.0))
     bomb_live = (state.bomb_live | drop) & (bomb[1] < 1.0)
 

@@ -50,7 +50,10 @@ def _decode(action: jax.Array):
     punch_combo = action >= 10
     base = jnp.where(punch_combo, action - 8, action)  # 10..17 -> 2..9
     base = jnp.clip(base, 0, 9)
-    d = _MOVES[base]
+    # one-hot contraction, not _MOVES[base]: per-env scalar gathers lower
+    # to pathological batched gathers under vmap in the fused program
+    oh = (jnp.arange(10) == base).astype(jnp.float32)
+    d = oh @ _MOVES
     punch = (action == 1) | punch_combo
     return d[0], d[1], punch
 

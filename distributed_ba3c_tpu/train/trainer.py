@@ -7,9 +7,9 @@ TPU-first:
 - ``run_step``'s ``sess.run(train_op)`` + async PS gradient push becomes one
   jitted shard_map step with the grads psum'd over the mesh (§3.4 replaced).
 - ``QueueInput``/``EnqueueThread`` become ``TrainFeed`` (host batcher thread)
-  + double-buffered ``jax.device_put``: the next batch is staged while the
-  (asynchronously dispatched) device step runs, so batching + H2D transfer
-  overlap compute.
+  + ``jax.device_put`` at the head of each step: device dispatch is async,
+  so staging the next batch overlaps the previous step's execution (see the
+  ``run_step`` note — no explicit double buffer exists or is needed).
 - The predict towers' shared-variable reads become an explicit params publish
   to the BatchedPredictor every ``publish_every`` steps (on-device ref swap,
   no host copy).

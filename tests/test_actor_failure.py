@@ -1,0 +1,105 @@
+"""Failure injection: an actor dies mid-stream; the plane keeps producing.
+
+SURVEY.md §5 (failure detection): the reference tolerated NO actor loss —
+a dead SimulatorProcess silently starved its client slot forever. Here the
+master prunes silent clients after ``actor_timeout`` (actors/simulator.py
+``_prune_dead_actors``) and the surviving actors keep the train queue fed.
+This test SIGKILLs one of three simulator processes mid-run and asserts
+both behaviors — the chaos case the unit tests of the pruning logic don't
+cover.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import queue
+import signal
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_ba3c_tpu.actors.master import BA3CSimulatorMaster
+from distributed_ba3c_tpu.actors.simulator import SimulatorProcess
+from distributed_ba3c_tpu.config import BA3CConfig
+from distributed_ba3c_tpu.envs.fake import build_fake_player
+from distributed_ba3c_tpu.models.a3c import BA3CNet
+from distributed_ba3c_tpu.predict.server import BatchedPredictor
+from distributed_ba3c_tpu.utils.concurrency import ensure_proc_terminate
+
+
+def _drain(master, n, deadline_s):
+    got = []
+    deadline = time.time() + deadline_s
+    while len(got) < n and time.time() < deadline:
+        try:
+            got.append(master.queue.get(timeout=2))
+        except queue.Empty:
+            pass
+    return got
+
+
+@pytest.mark.slow
+def test_actor_killed_mid_run_is_pruned_and_plane_survives(tmp_path):
+    cfg = BA3CConfig(image_size=(16, 16), fc_units=16, num_actions=4)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, *cfg.state_shape), np.uint8)
+    )["params"]
+    predictor = BatchedPredictor(model, params, batch_size=4, num_threads=1)
+
+    c2s, s2c = f"ipc://{tmp_path}/c2s", f"ipc://{tmp_path}/s2c"
+    master = BA3CSimulatorMaster(
+        c2s,
+        s2c,
+        predictor,
+        gamma=cfg.gamma,
+        local_time_max=cfg.local_time_max,
+        score_queue=queue.Queue(maxsize=100),
+        actor_timeout=3.0,
+    )
+    build = functools.partial(
+        build_fake_player,
+        image_size=cfg.image_size,
+        frame_history=cfg.frame_history,
+        num_actions=cfg.num_actions,
+    )
+    procs = [SimulatorProcess(i, c2s, s2c, build) for i in range(3)]
+    ensure_proc_terminate(procs)
+
+    predictor.start()
+    master.start()
+    for p in procs:
+        p.start()
+
+    try:
+        # healthy phase: all three actors register and produce
+        assert len(_drain(master, 32, 120)) >= 32
+        n_clients_before = len(master.clients)
+        assert n_clients_before >= 3
+
+        # chaos: SIGKILL one actor (no goodbye on the wire)
+        os.kill(procs[0].pid, signal.SIGKILL)
+        procs[0].join(timeout=10)
+
+        # survivors keep the queue fed...
+        assert len(_drain(master, 32, 120)) >= 32
+        # ...and the dead client's state is eventually pruned
+        deadline = time.time() + 30
+        while len(master.clients) >= n_clients_before and time.time() < deadline:
+            time.sleep(0.5)
+        assert len(master.clients) < n_clients_before, (
+            "dead actor never pruned",
+            len(master.clients),
+        )
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        master.close()
+        predictor.stop()
+        predictor.join(timeout=5)
+        for p in procs:
+            p.join(timeout=5)
