@@ -36,15 +36,15 @@ class PolicyValue(NamedTuple):
     value: jax.Array   # [B] float32
 
 
-def _pallas_ok(x: jax.Array, features: int, k: int, pooled: bool) -> bool:
-    """Geometry the fused Pallas block can compile (ops/pallas_conv.py)."""
-    from distributed_ba3c_tpu.ops.pallas_conv import ConvSpec, supported
+def _conv_spec(x: jax.Array, features: int, k: int, pooled: bool):
+    """The ONE ConvSpec construction shared by the gate and the executed
+    block, so they can never diverge (ops/pallas_conv.py)."""
+    from distributed_ba3c_tpu.ops.pallas_conv import ConvSpec
 
-    s = ConvSpec(
+    return ConvSpec(
         H=x.shape[1], W=x.shape[2], Ci=x.shape[3], Co=features,
         kh=k, kw=k, pool=pooled, scale_uint8=False,
     )
-    return supported(s)
 
 
 class _PallasConvBlock(nn.Module):
@@ -55,33 +55,25 @@ class _PallasConvBlock(nn.Module):
     on the CPU backend.
     """
 
-    features: int
-    kernel_size: int
-    pool: bool
+    spec: object  # ConvSpec (static)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        from distributed_ba3c_tpu.ops.pallas_conv import ConvSpec, conv_block
+        from distributed_ba3c_tpu.ops.pallas_conv import conv_block
 
-        B, H, W, Ci = x.shape
-        k = self.kernel_size
+        s = self.spec
+        B = x.shape[0]
         kernel = self.param(
             "kernel", nn.initializers.lecun_normal(),
-            (k, k, Ci, self.features), jnp.float32,
+            (s.kh, s.kw, s.Ci, s.Co), jnp.float32,
         )
-        bias = self.param(
-            "bias", nn.initializers.zeros, (self.features,), jnp.float32
-        )
-        s = ConvSpec(
-            H=H, W=W, Ci=Ci, Co=self.features, kh=k, kw=k,
-            pool=self.pool, scale_uint8=False,
-        )
+        bias = self.param("bias", nn.initializers.zeros, (s.Co,), jnp.float32)
         y = conv_block(
-            x.astype(jnp.bfloat16).reshape(B, H, W * Ci),
+            x.astype(jnp.bfloat16).reshape(B, s.H, s.W * s.Ci),
             kernel, bias, s,
             jax.default_backend() != "tpu",
         )
-        return y.reshape(B, s.Ho, s.Wo, self.features)
+        return y.reshape(B, s.Ho, s.Wo, s.Co)
 
 
 class BA3CNet(nn.Module):
@@ -131,16 +123,13 @@ class BA3CNet(nn.Module):
             # stay interchangeable between configurations
             # the Pallas block is bf16-only; any other compute dtype must
             # use the XLA path to honor the requested precision
-            if (
-                self.conv_backend == "pallas"
-                and self.compute_dtype == jnp.bfloat16
-                and _pallas_ok(x, feats, k, pooled)
-            ):
-                x = _PallasConvBlock(
-                    features=feats, kernel_size=k, pool=pooled,
-                    name=f"Conv_{i}",
-                )(x)
-                continue  # relu+pool fused inside the block
+            if self.conv_backend == "pallas" and self.compute_dtype == jnp.bfloat16:
+                from distributed_ba3c_tpu.ops.pallas_conv import supported
+
+                spec = _conv_spec(x, feats, k, pooled)
+                if supported(spec):
+                    x = _PallasConvBlock(spec=spec, name=f"Conv_{i}")(x)
+                    continue  # relu+pool fused inside the block
             if pack and pack > 1:
                 from distributed_ba3c_tpu.models.packed_conv import PackedConv
 
