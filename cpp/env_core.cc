@@ -869,7 +869,11 @@ class BoxingEnv : public Env {
     op_x_ = std::clamp(op_x_, B::kRingLo, B::kRingHi);
     op_y_ = std::clamp(op_y_, B::kRingLo, B::kRingHi);
 
-    bool in_range = dist <= B::kPunchRange;
+    // range test uses the POST-move distance (boxing.py computes
+    // in_range from me-opp after the chase/jitter move); knockback below
+    // keeps the pre-move dx/dist vector, also matching the JAX plane
+    float pdx = me_x_ - op_x_, pdy = me_y_ - op_y_;
+    bool in_range = std::sqrt(pdx * pdx + pdy * pdy) <= B::kPunchRange;
     bool my_land = punch && in_range && my_cd_ <= 0;
     bool op_land = uni(rng_) < B::kOppPunchP && in_range && op_cd_ <= 0;
     // knockback pushes the punched boxer AWAY from the puncher (dx = me-op)

@@ -354,21 +354,31 @@ def main(argv: Optional[list] = None) -> int:
         ]
 
     # Order matters: Evaluator adds its stats BEFORE StatPrinter finalizes the
-    # epoch record, and MaxSaver reads last_mean_score set by StatPrinter.
+    # epoch record, and MaxSaver reads the monitored stat from that record.
     chief = is_chief()
+    # Where an Evaluator runs, keep-best follows the GREEDY eval score (the
+    # reference MaxSaver kept the Evaluator's best); otherwise fall back to
+    # the sampling-policy mean.
+    run_eval = chief and args.nr_eval > 0
     callbacks = [
         StartProcOrThread([predictor, master, feed] + procs),
         HumanHyperParamSetter("learning_rate", shared_dir=base_logdir),
+        HumanHyperParamSetter("entropy_beta", shared_dir=base_logdir),
         StatPrinter(),
         # ONE checkpoint dir for every host: orbax saves are collective and
         # must target the same path on all processes
         ModelSaver(ckpt_dir=os.path.join(base_logdir, "checkpoints")),
-        MaxSaver(),
+        MaxSaver(monitor="eval_mean_score" if run_eval else "mean_score"),
     ]
-    if chief:
-        # chief-only eval, matching the reference's chief-worker summary role
+    if run_eval:
+        # chief-only eval, matching the reference's chief-worker summary
+        # role; MUST run before StatPrinter so eval stats land in THIS
+        # epoch's record (MaxSaver reads that record)
+        stat_printer_idx = next(
+            i for i, cb in enumerate(callbacks) if isinstance(cb, StatPrinter)
+        )
         callbacks.insert(
-            2,
+            stat_printer_idx,
             PeriodicTrigger(
                 Evaluator(args.nr_eval, build_player),
                 every_k_epochs=args.eval_every,

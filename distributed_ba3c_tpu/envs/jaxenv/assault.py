@@ -97,15 +97,19 @@ def _substep(state: State, move, fire, vent, key: jax.Array):
     mother_x = jnp.clip(mother_x, MOTHER_W, 1 - MOTHER_W)
 
     # spawn an attacker in a random free lane, dropping from the mothership
+    # (one-hot lane mask, not att_live[lane]/.at[lane]: per-env scalar
+    # gathers/scatters are pathological under vmap — see package rule)
     lane = jax.random.randint(k_lane, (), 0, N_LANES)
-    can = ~state.att_live[lane]
+    lane_oh = jnp.arange(N_LANES) == lane
+    can = ~jnp.any(state.att_live & lane_oh)
     spawn = (jax.random.uniform(k_spawn) < SPAWN_P) & can
-    att_pos = state.att_pos.at[lane].set(
-        jnp.where(
-            spawn, jnp.stack([mother_x, MOTHER_Y + 0.05]), state.att_pos[lane]
-        )
+    spawn_oh = lane_oh & spawn
+    att_pos = jnp.where(
+        spawn_oh[:, None],
+        jnp.stack([mother_x, MOTHER_Y + 0.05])[None, :],
+        state.att_pos,
     )
-    att_live = state.att_live.at[lane].set(state.att_live[lane] | spawn)
+    att_live = state.att_live | spawn_oh
 
     # attackers descend and strafe toward the player
     dx = jnp.sign(player_x - att_pos[:, 0]) * STRAFE

@@ -10,7 +10,9 @@ the episode (reward 0).
 
 Per-level DIFFICULTY is part of the distribution (as in procgen, whose
 level generator varies section count and hazards): the goal sits
-12..62 tiles out and gap/spike densities scale by a per-level draw. That
+6..62 tiles out (the deliberately easy 6-tile floor — fully protected,
+hazard-free levels — is what makes the +10 reachable by exploration at
+all) and gap/spike densities scale by a per-level draw. That
 spread is what makes the sparse +10 learnable at all — uniform-random play
 finishes the short easy levels occasionally (measured: ~37k uniform
 episodes on fixed 64-tile max-difficulty levels produced ZERO coins), and
@@ -51,7 +53,7 @@ class State(NamedTuple):
     vy: jax.Array        # [] vertical velocity
     heights: jax.Array   # [LEVEL_LEN] terrain height (0 = gap)
     spikes: jax.Array    # [LEVEL_LEN] bool
-    goal: jax.Array      # [] float32 coin tile (12..LEVEL_LEN-2)
+    goal: jax.Array      # [] float32 coin tile (6..LEVEL_LEN-2)
     t: jax.Array         # [] int32
 
 

@@ -167,9 +167,12 @@ def _substep(state: State, move: jax.Array, fire: jax.Array, key: jax.Array):
     lives = state.lives - jnp.any(hit_player).astype(jnp.int32)
     bombs_live = bombs_live & ~hit_player & (bombs[:, 1] < 1.0)
 
-    # fleet landed -> all lives lost (game over)
+    # fleet landed -> all lives lost (game over); use the POST-march row
+    # positions so an edge-descend triggers this substep, matching the C++
+    # mirror's ordering
+    _, cy_post = _alien_centers(origin)
     landed = jnp.any(
-        aliens & ((cy[:, None] + ALIEN_H) >= PLAYER_Y - 0.02)
+        aliens & ((cy_post[:, None] + ALIEN_H) >= PLAYER_Y - 0.02)
     )
     lives = jnp.where(landed, 0, lives)
 

@@ -373,7 +373,14 @@ class ModelSaver(Callback):
 
 
 class MaxSaver(Callback):
-    """Mark the checkpoint as best when the monitored stat improves."""
+    """Mark the checkpoint as best when the monitored stat improves.
+
+    Reads the stat named by ``monitor`` from the epoch record StatPrinter
+    just finalized (so ``eval_mean_score`` tracks the greedy Evaluator, not
+    the sampling-policy mean — reference ``MaxSaver`` kept the Evaluator's
+    best, SURVEY.md §2.7 #20). Epochs where the monitored stat is absent
+    (e.g. ``--eval_every > 1``) leave the best pointer untouched.
+    """
 
     def __init__(self, monitor: str = "mean_score"):
         self.monitor = monitor
@@ -382,7 +389,10 @@ class MaxSaver(Callback):
         tr = self.trainer
         if tr.ckpt_manager is None:
             return
-        score = tr.last_mean_score
+        history = tr.stat_holder.stat_history
+        score = history[-1].get(self.monitor) if history else None
+        if score is None and self.monitor == "mean_score":
+            score = tr.last_mean_score  # pre-StatPrinter wiring fallback
         if score is not None and tr.ckpt_manager.mark_best(
             tr.global_step, score
         ):

@@ -117,14 +117,17 @@ def run_fused_mode(rank: int, nprocs: int, coordinator: str, logdir: str) -> Non
     print(f"CLI_RC {rc}", flush=True)
 
 
-def run_cli_mode(rank: int, nprocs: int, coordinator: str, logdir: str) -> None:
+def run_cli_mode(
+    rank: int, nprocs: int, coordinator: str, logdir: str, trainer=None
+) -> None:
     from distributed_ba3c_tpu.cli import main
 
     hosts = ",".join(
         [coordinator] + [f"x{i}:0" for i in range(1, nprocs)]
     )
     rc = main(
-        [
+        ([] if trainer is None else ["--trainer", trainer])
+        + [
             "--env", "fake",
             "--worker_hosts", hosts,
             "--task_index", str(rank),
@@ -155,6 +158,10 @@ if __name__ == "__main__":
 
     if mode == "cli":
         run_cli_mode(rank, nprocs, coordinator, sys.argv[5])
+    elif mode == "vtrace":
+        run_cli_mode(
+            rank, nprocs, coordinator, sys.argv[5], trainer="tpu_vtrace_ba3c"
+        )
     elif mode == "fused":
         run_fused_mode(rank, nprocs, coordinator, sys.argv[5])
     else:

@@ -69,6 +69,57 @@ def test_human_setter(tmp_path):
     assert tr.hyperparams["learning_rate"] == pytest.approx(0.042)
 
 
+def test_human_setter_moves_both_knobs(tmp_path):
+    """ONE hyper.txt drives learning_rate AND entropy_beta (SURVEY §2.7 #21)."""
+    tr = _FakeTrainer(log_dir=str(tmp_path))
+    cbs = [
+        HumanHyperParamSetter("learning_rate"),
+        HumanHyperParamSetter("entropy_beta"),
+    ]
+    for cb in cbs:
+        cb.setup(tr)
+    (tmp_path / "hyper.txt").write_text(
+        "learning_rate: 0.0003\nentropy_beta: 0.001\n"
+    )
+    for cb in cbs:
+        cb.trigger_epoch()
+    assert tr.hyperparams["learning_rate"] == pytest.approx(3e-4)
+    assert tr.hyperparams["entropy_beta"] == pytest.approx(1e-3)
+
+
+def test_max_saver_follows_monitor_stat(tmp_path):
+    """MaxSaver reads the stat it names from the epoch record: the best
+    pointer must follow greedy eval, not the sampling mean (VERDICT r2 #4)."""
+    from distributed_ba3c_tpu.train.callbacks import MaxSaver
+
+    tr = _FakeTrainer(log_dir=str(tmp_path))
+    tr.stat_holder = StatHolder(str(tmp_path), tensorboard=False)
+    tr.ckpt_manager = CheckpointManager(str(tmp_path / "ck"))
+    cb = MaxSaver(monitor="eval_mean_score")
+    cb.setup(tr)
+
+    def epoch(step, sampling_mean, eval_mean):
+        tr.global_step = step
+        tr.last_mean_score = sampling_mean
+        tr.stat_holder.add_stat("mean_score", sampling_mean)
+        if eval_mean is not None:
+            tr.stat_holder.add_stat("eval_mean_score", eval_mean)
+        tr.stat_holder.finalize()
+        cb.trigger_epoch()
+
+    epoch(100, 5.0, 10.0)
+    assert tr.ckpt_manager.best_step == 100
+    # sampling mean jumps but eval is absent this epoch -> best unchanged
+    epoch(200, 50.0, None)
+    assert tr.ckpt_manager.best_step == 100
+    # sampling mean FALLS while eval improves -> best follows eval
+    epoch(300, 1.0, 12.0)
+    assert tr.ckpt_manager.best_step == 300
+    # eval regresses -> best stays
+    epoch(400, 99.0, 8.0)
+    assert tr.ckpt_manager.best_step == 300
+
+
 def test_periodic_trigger_epochs():
     tr = _FakeTrainer()
     fired = []

