@@ -74,8 +74,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--rollout_len", type=int, default=20, help="fused-trainer rollout length per update")
     p.add_argument("--grad_chunk_samples", type=int, default=4096, help="fused-trainer learner chunk size (HBM activation cap)")
     p.add_argument("--actor_timeout", type=float, default=120.0, help="seconds of actor silence before its state is dropped (0=off)")
-    p.add_argument("--entropy_beta_final", type=float, default=None, help="linear-anneal entropy beta to this over max_epoch (ScheduledHyperParamSetter)")
-    p.add_argument("--learning_rate_final", type=float, default=None, help="linear-anneal LR to this over max_epoch (ScheduledHyperParamSetter)")
+    p.add_argument("--entropy_beta_final", type=float, default=None, help="anneal entropy beta to this over max_epoch (ScheduledHyperParamSetter)")
+    p.add_argument("--learning_rate_final", type=float, default=None, help="anneal LR to this over max_epoch (ScheduledHyperParamSetter)")
+    p.add_argument("--anneal", default="linear", choices=["linear", "exp"], help="shape of the *_final anneals: linear or geometric (exp)")
     p.add_argument("--profiler_port", type=int, default=0, help="start jax.profiler server on this port (0=off)")
     return p
 
@@ -390,7 +391,7 @@ def main(argv: Optional[list] = None) -> int:
             ScheduledHyperParamSetter(
                 "learning_rate",
                 [(1, cfg.learning_rate), (args.max_epoch, args.learning_rate_final)],
-                interp="linear",
+                interp=args.anneal,
             )
         )
     if args.entropy_beta_final is not None:
@@ -398,7 +399,7 @@ def main(argv: Optional[list] = None) -> int:
             ScheduledHyperParamSetter(
                 "entropy_beta",
                 [(1, cfg.entropy_beta), (args.max_epoch, args.entropy_beta_final)],
-                interp="linear",
+                interp=args.anneal,
             )
         )
     from distributed_ba3c_tpu.train.experiment import ExperimentLogger

@@ -490,12 +490,17 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
     )
 
     # runtime-scheduled hyperparams (reference ScheduledHyperParamSetter
-    # semantics): linear anneal over epochs when *_final flags are given
+    # semantics): anneal over epochs when *_final flags are given. --anneal
+    # exp interpolates geometrically — it reaches the low-β/low-lr regime
+    # (where Pong's endgame learning happens) in half the epochs a linear
+    # ramp spends at plateau values.
     def sched(v0, v1, epoch):
         if v1 is None or args.max_epoch <= 1:
             return v0
+        from distributed_ba3c_tpu.train.callbacks import anneal_interp
+
         f = (epoch - 1) / (args.max_epoch - 1)
-        return v0 + f * (v1 - v0)
+        return anneal_interp(v0, v1, f, getattr(args, "anneal", "linear"))
 
     # greedy on-device Evaluator (reference Evaluator, SURVEY.md §3.5):
     # nr_eval envs rounded up to the mesh's data axis
@@ -521,7 +526,25 @@ def _fused_epoch_loop(
     from distributed_ba3c_tpu.utils import logger
 
     best = -np.inf
-    for epoch in range(1, args.max_epoch + 1):
+    # Resume CONTINUES the schedule: the epoch counter derives from the
+    # restored global step, so a stall-kill + --load (run_with_resume.sh)
+    # picks up the anneal where it left off instead of restarting it —
+    # --max_epoch is the run's TOTAL epoch budget across resumes.
+    epoch0 = int(state.train.step) // max(args.steps_per_epoch, 1)
+    if epoch0 > 0:
+        logger.info(
+            "resume: continuing at epoch %d/%d (restored step %d)",
+            epoch0 + 1, args.max_epoch, int(state.train.step),
+        )
+    if epoch0 >= args.max_epoch:
+        # a warm-start fine-tune wants a FRESH logdir (the anneal maps over
+        # epochs 1..max_epoch of the loaded step count); loud, not silent
+        logger.warn(
+            "loaded step %d already covers --max_epoch %d x %d steps: "
+            "nothing to train (raise --max_epoch to extend the run)",
+            int(state.train.step), args.max_epoch, args.steps_per_epoch,
+        )
+    for epoch in range(epoch0 + 1, args.max_epoch + 1):
         beta = sched(cfg.entropy_beta, args.entropy_beta_final, epoch)
         lr = sched(cfg.learning_rate, args.learning_rate_final, epoch)
         t0 = time.time()

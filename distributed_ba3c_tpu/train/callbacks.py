@@ -205,8 +205,31 @@ class HyperParamSetter(Callback):
         self._set()
 
 
+def anneal_interp(v0: float, v1: float, frac: float, mode: str) -> float:
+    """Interpolate a hyperparam between ``v0`` and ``v1`` at ``frac`` ∈ [0,1].
+
+    The ONE schedule formula shared by ScheduledHyperParamSetter and the
+    fused loop's ``sched`` (so the two trainers cannot silently diverge).
+    ``mode="exp"`` is geometric and requires positive endpoints — a zero or
+    negative value would silently cliff / go complex, so it raises instead.
+    """
+    frac = min(max(frac, 0.0), 1.0)
+    if mode == "exp":
+        if v0 <= 0 or v1 <= 0:
+            raise ValueError(
+                f"exp anneal needs positive endpoints, got {v0} -> {v1}"
+            )
+        return v0 * (v1 / v0) ** frac
+    return v0 + frac * (v1 - v0)
+
+
 class ScheduledHyperParamSetter(HyperParamSetter):
-    """Piecewise schedule [(epoch, value), ...]; optional linear interp."""
+    """Piecewise schedule [(epoch, value), ...]; optional linear/exp interp.
+
+    ``interp="exp"`` interpolates geometrically between knots (both values
+    must be positive) — the shape that reaches a low-lr/low-β endgame
+    quickly instead of spending half the run at plateau values.
+    """
 
     def __init__(
         self,
@@ -216,7 +239,7 @@ class ScheduledHyperParamSetter(HyperParamSetter):
     ):
         super().__init__(name)
         self.schedule = sorted(schedule)
-        assert interp in (None, "linear")
+        assert interp in (None, "linear", "exp")
         self.interp = interp
 
     def _value_to_set(self) -> Optional[float]:
@@ -229,7 +252,7 @@ class ScheduledHyperParamSetter(HyperParamSetter):
                 if self.interp is None or laste is None:
                     return lastv
                 frac = (e - laste) / (se - laste)
-                return lastv + frac * (sv - lastv)
+                return anneal_interp(lastv, sv, frac, self.interp)
             laste, lastv = se, sv
         return lastv
 

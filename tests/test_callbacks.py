@@ -51,6 +51,20 @@ def test_scheduled_setter_linear_interp():
     assert tr.hyperparams["beta"] == pytest.approx(0.5)
 
 
+def test_scheduled_setter_exp_interp():
+    tr = _FakeTrainer()
+    cb = ScheduledHyperParamSetter(
+        "beta", [(1, 1e-2), (41, 1e-4)], interp="exp"
+    )
+    cb.setup(tr)
+    tr.epoch_num = 21  # geometric midpoint of a 2-decade anneal
+    cb.trigger_epoch()
+    assert tr.hyperparams["beta"] == pytest.approx(1e-3)
+    tr.epoch_num = 41
+    cb.trigger_epoch()
+    assert tr.hyperparams["beta"] == pytest.approx(1e-4)
+
+
 def test_func_setter():
     tr = _FakeTrainer()
     cb = HyperParamSetterWithFunc("lr", lambda e, cur: 0.1 / (e + 1))

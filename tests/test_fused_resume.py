@@ -1,0 +1,56 @@
+"""Fused-trainer resume continues the run (epoch counter + schedule).
+
+A stall-kill + ``--load`` (scripts/run_with_resume.sh) must CONTINUE the
+single-command run: the epoch counter derives from the restored global step,
+so ``--max_epoch`` is a total budget and the LR/β anneal picks up where it
+left off instead of restarting from the top (the failure mode that made the
+round-2 north-star a hand-driven multi-phase recipe).
+"""
+
+import json
+import os
+
+import pytest
+
+from distributed_ba3c_tpu.cli import main
+
+
+def _run(logdir, max_epoch, load=False):
+    args = [
+        "--trainer", "tpu_fused_ba3c",
+        "--env", "jax:pong",
+        "--batch_size", "8",
+        "--rollout_len", "2",
+        "--fc_units", "16",
+        "--steps_per_epoch", "2",
+        "--max_epoch", str(max_epoch),
+        "--nr_eval", "1",
+        "--eval_max_steps", "8",
+        "--learning_rate_final", "1e-5",
+        "--anneal", "exp",
+        "--logdir", logdir,
+    ]
+    if load:
+        args += ["--load", os.path.join(logdir, "checkpoints")]
+    return main(args)
+
+
+@pytest.mark.slow
+def test_fused_resume_continues_epochs(tmp_path):
+    logdir = str(tmp_path / "run")
+    assert _run(logdir, max_epoch=2) == 0
+    stats = json.load(open(os.path.join(logdir, "stat.json")))
+    assert [s["epoch"] for s in stats] == [1, 2]
+    assert [s["global_step"] for s in stats] == [2, 4]
+
+    # resume with a LARGER total budget: continues at epoch 3, not epoch 1
+    assert _run(logdir, max_epoch=4, load=True) == 0
+    stats = json.load(open(os.path.join(logdir, "stat.json")))
+    assert [s["epoch"] for s in stats] == [1, 2, 3, 4]
+    assert [s["global_step"] for s in stats] == [2, 4, 6, 8]
+
+    # resume with the budget already spent: a no-op clean exit (this is what
+    # lets run_with_resume.sh terminate after the final restart)
+    assert _run(logdir, max_epoch=4, load=True) == 0
+    stats = json.load(open(os.path.join(logdir, "stat.json")))
+    assert len(stats) == 4
