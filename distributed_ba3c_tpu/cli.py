@@ -44,7 +44,7 @@ def make_parser() -> argparse.ArgumentParser:
     )
     # -- run mode ----------------------------------------------------------
     p.add_argument("--task", default="train", choices=["train", "eval", "play"])
-    p.add_argument("--env", default="fake", help="fake | jax:<name> (on-device env, e.g. jax:pong) | cpp:<name> (native batched core) | gym:<name> (gymnasium adapter) | zmq:<addr> (external env server)")
+    p.add_argument("--env", default="fake", help="fake | jax:<name> (on-device env, e.g. jax:pong) | cpp:<name> (native batched core) | gym:<name> (gymnasium adapter) | zmq:<game> (REMOTE env-server fleets play <game> and connect to --pipe_c2s/--pipe_s2c; no local simulators)")
     p.add_argument("--load", default=None, help="checkpoint dir to resume from")
     p.add_argument("--logdir", default="train_log/ba3c")
     # -- hyperparams (reference argparse defaults, SURVEY.md §2.9) ---------
@@ -77,18 +77,35 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--entropy_beta_final", type=float, default=None, help="anneal entropy beta to this over max_epoch (ScheduledHyperParamSetter)")
     p.add_argument("--learning_rate_final", type=float, default=None, help="anneal LR to this over max_epoch (ScheduledHyperParamSetter)")
     p.add_argument("--anneal", default="linear", choices=["linear", "exp"], help="shape of the *_final anneals: linear or geometric (exp)")
+    p.add_argument("--anneal_lr", default=None, choices=["linear", "exp"], help="override --anneal for learning_rate only (β and lr want different shapes: β drops early, lr holds through the mid-game)")
+    p.add_argument("--anneal_beta", default=None, choices=["linear", "exp"], help="override --anneal for entropy_beta only")
     p.add_argument("--profiler_port", type=int, default=0, help="start jax.profiler server on this port (0=off)")
+    p.add_argument("--pipe_c2s", default=None, help="master experience-plane bind address, e.g. tcp://0.0.0.0:5555 (default: per-pid ipc://)")
+    p.add_argument("--pipe_s2c", default=None, help="master action-plane bind address, e.g. tcp://0.0.0.0:5556 (default: per-pid ipc://)")
     return p
 
 
 def env_num_actions(args) -> int:
     """Derive the action-space size from the selected env (every trainer must
     build the policy head against the ENV's space, not the flag default)."""
-    if args.env.startswith(("jax:", "cpp:")):
-        # jaxenv and the C++ core keep identical action maps (tested parity)
+    if args.env.startswith(("jax:", "cpp:", "zmq:")) and args.env != "zmq:":
+        # jaxenv and the C++ core keep identical action maps (tested
+        # parity); zmq:<game> names the game the EXTERNAL fleets play, so
+        # the policy head still gets the right action space. An unknown
+        # zmq: game fails LOUDLY — a silent --num_actions fallback would
+        # train a wrong-sized policy head against the fleet.
         from distributed_ba3c_tpu.envs import jaxenv
 
-        return jaxenv.get_env(args.env.split(":", 1)[1]).num_actions
+        try:
+            return jaxenv.get_env(args.env.split(":", 1)[1]).num_actions
+        except ValueError:
+            if not args.env.startswith("zmq:"):
+                raise
+            raise SystemExit(
+                f"--env {args.env}: unknown game — for fleets playing a "
+                "game this build doesn't know, use bare '--env zmq:' plus "
+                "an explicit --num_actions"
+            )
     return args.num_actions
 
 
@@ -155,16 +172,12 @@ def _build_player_factory(args, cfg: BA3CConfig):
             image_size=cfg.image_size,
         )
     if args.env.startswith("zmq:"):
-        # external env servers (e.g. remote CppEnvServerProcess fleets)
-        # already speak the simulator wire protocol — there is no in-process
-        # player to build; point the SERVERS at this trainer's tcp:// master
-        # pipes (actors stay host-side over ZMQ even multi-host, SURVEY §2.12)
+        # external env servers already speak the simulator wire protocol —
+        # there is no in-process player to build (train mode handles zmq:
+        # before calling this; only --task eval/play land here)
         raise SystemExit(
-            "--env zmq:<addr> is not a player factory: external env servers "
-            "connect TO the master's pipes. Use --env cpp:<game> for local "
-            "native servers, or launch remote env servers pointed at this "
-            "host's c2s/s2c tcp:// endpoints (envs/native.py "
-            "CppEnvServerProcess takes the pipe addresses directly)."
+            "--env zmq: has no in-process player (external fleets own the "
+            "envs) — --task eval/play need a local env, e.g. --env cpp:pong"
         )
     raise ValueError(f"unknown --env {args.env!r}")
 
@@ -212,6 +225,9 @@ def main(argv: Optional[list] = None) -> int:
         args.logdir = f"{args.logdir}-worker{args.task_index}"
     # shared checkpoint dir for ALL trainers incl. fused (collective saves)
     args.shared_ckpt_dir = os.path.join(base_logdir, "checkpoints")
+    # ONE hyper.txt for every host (fused loop live overrides; the ZMQ
+    # trainers' HumanHyperParamSetter gets the same dir below)
+    args.shared_hyper_dir = base_logdir
 
     from distributed_ba3c_tpu.models.a3c import BA3CNet
     from distributed_ba3c_tpu.ops.gradproc import make_optimizer
@@ -271,18 +287,31 @@ def main(argv: Optional[list] = None) -> int:
     )
     from distributed_ba3c_tpu.train.trainer import Trainer, TrainLoopConfig
 
-    build_player = _build_player_factory(args, cfg)
-    # train-mode episode guards (reference get_player(train=True) stacked
-    # PreventStuck + LimitLength around the simulators; eval stays unguarded)
-    from distributed_ba3c_tpu.envs.wrappers import guarded_player
+    # --env zmq: = REMOTE actor fleets (BASELINE config #3's topology): no
+    # local simulators — external env servers (CppEnvServerProcess or any
+    # wire-compatible speaker) connect to this learner's tcp:// pipes.
+    external_fleet = args.env.startswith("zmq:")
+    if external_fleet:
+        if not (args.pipe_c2s and args.pipe_s2c):
+            raise SystemExit(
+                "--env zmq: means external env-server fleets feed this "
+                "learner — give them reachable endpoints via --pipe_c2s/"
+                "--pipe_s2c (e.g. tcp://0.0.0.0:5555 / tcp://0.0.0.0:5556)"
+            )
+        build_player = None
+    else:
+        build_player = _build_player_factory(args, cfg)
+        # train-mode episode guards (reference get_player(train=True) stacked
+        # PreventStuck + LimitLength around the simulators; eval unguarded)
+        from distributed_ba3c_tpu.envs.wrappers import guarded_player
 
-    sim_build_player = functools.partial(
-        guarded_player,
-        base_build=build_player,
-        episode_length_cap=cfg.episode_length_cap,
-        stuck_limit=30,
-        stuck_action=1,
-    )
+        sim_build_player = functools.partial(
+            guarded_player,
+            base_build=build_player,
+            episode_length_cap=cfg.episode_length_cap,
+            stuck_limit=30,
+            stuck_action=1,
+        )
     predictor = BatchedPredictor(
         model,
         state.params,
@@ -292,7 +321,14 @@ def main(argv: Optional[list] = None) -> int:
     # precompile every serving bucket now — a first-time bucket compile
     # mid-training stalls the whole actor plane for tens of seconds
     predictor.warmup(cfg.state_shape)
-    c2s, s2c = default_pipes()
+    # explicit pipe addresses (tcp:// for cross-host fleets) override the
+    # per-pid ipc:// defaults; the master BINDS, env servers connect
+    if args.pipe_c2s and args.pipe_s2c:
+        c2s, s2c = args.pipe_c2s, args.pipe_s2c
+    elif args.pipe_c2s or args.pipe_s2c:
+        raise SystemExit("--pipe_c2s and --pipe_s2c must be given together")
+    else:
+        c2s, s2c = default_pipes()
     score_q: queue.Queue = queue.Queue(maxsize=4096)
     n_data = mesh.shape["data"]
     n_hosts = jax.process_count()
@@ -330,7 +366,14 @@ def main(argv: Optional[list] = None) -> int:
             local_batch_slice(cfg.batch_size)  # asserts host divisibility
         feed = TrainFeed(master.queue, cfg.batch_size // n_hosts)
         samples_per_step = cfg.batch_size
-    if args.env.startswith("cpp:"):
+    if external_fleet:
+        # remote fleets own the envs; nothing to start locally
+        procs = []
+        logger.info(
+            "external-fleet mode: master pipes bound at %s (c2s) / %s (s2c) "
+            "— waiting for env servers to connect", c2s, s2c,
+        )
+    elif args.env.startswith("cpp:"):
         # batched native servers: each process hosts up to 16 envs in lockstep
         from distributed_ba3c_tpu.envs import native
 
@@ -360,7 +403,7 @@ def main(argv: Optional[list] = None) -> int:
     # Where an Evaluator runs, keep-best follows the GREEDY eval score (the
     # reference MaxSaver kept the Evaluator's best); otherwise fall back to
     # the sampling-policy mean.
-    run_eval = chief and args.nr_eval > 0
+    run_eval = chief and args.nr_eval > 0 and build_player is not None
     callbacks = [
         StartProcOrThread([predictor, master, feed] + procs),
         HumanHyperParamSetter("learning_rate", shared_dir=base_logdir),
@@ -391,7 +434,7 @@ def main(argv: Optional[list] = None) -> int:
             ScheduledHyperParamSetter(
                 "learning_rate",
                 [(1, cfg.learning_rate), (args.max_epoch, args.learning_rate_final)],
-                interp=args.anneal,
+                interp=args.anneal_lr or args.anneal,
             )
         )
     if args.entropy_beta_final is not None:
@@ -399,7 +442,7 @@ def main(argv: Optional[list] = None) -> int:
             ScheduledHyperParamSetter(
                 "entropy_beta",
                 [(1, cfg.entropy_beta), (args.max_epoch, args.entropy_beta_final)],
-                interp=args.anneal,
+                interp=args.anneal_beta or args.anneal,
             )
         )
     from distributed_ba3c_tpu.train.experiment import ExperimentLogger

@@ -29,6 +29,7 @@ batch) and psum'd into scalars only inside the metrics.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Callable
 
@@ -494,13 +495,15 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
     # exp interpolates geometrically — it reaches the low-β/low-lr regime
     # (where Pong's endgame learning happens) in half the epochs a linear
     # ramp spends at plateau values.
-    def sched(v0, v1, epoch):
+    def sched(v0, v1, epoch, mode=None):
         if v1 is None or args.max_epoch <= 1:
             return v0
         from distributed_ba3c_tpu.train.callbacks import anneal_interp
 
         f = (epoch - 1) / (args.max_epoch - 1)
-        return anneal_interp(v0, v1, f, getattr(args, "anneal", "linear"))
+        return anneal_interp(
+            v0, v1, f, mode or getattr(args, "anneal", "linear")
+        )
 
     # greedy on-device Evaluator (reference Evaluator, SURVEY.md §3.5):
     # nr_eval envs rounded up to the mesh's data axis
@@ -544,9 +547,34 @@ def _fused_epoch_loop(
             "nothing to train (raise --max_epoch to extend the run)",
             int(state.train.step), args.max_epoch, args.steps_per_epoch,
         )
+    # live hyperparam overrides (reference HumanHyperParamSetter, SURVEY
+    # §2.7 #21): the CHIEF reads <base_logdir>/hyper.txt each epoch and the
+    # values are broadcast — per-rank file reads could race a mid-run edit
+    # and silently diverge the psum'd update, so only the chief's read counts
+    hyper_dir = getattr(args, "shared_hyper_dir", None) or args.logdir
+    hyper_path = os.path.join(hyper_dir, "hyper.txt") if hyper_dir else None
+
+    def live_hyper(lr, beta):
+        if hyper_path is not None and jax.process_index() == 0:
+            from distributed_ba3c_tpu.train.callbacks import read_hyper_file
+
+            overrides = read_hyper_file(hyper_path)
+            lr = overrides.get("learning_rate", lr)
+            beta = overrides.get("entropy_beta", beta)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            lr, beta = multihost_utils.broadcast_one_to_all(
+                np.asarray([lr, beta], np.float32)
+            ).tolist()
+        return lr, beta
+
+    beta_mode = getattr(args, "anneal_beta", None)
+    lr_mode = getattr(args, "anneal_lr", None)
     for epoch in range(epoch0 + 1, args.max_epoch + 1):
-        beta = sched(cfg.entropy_beta, args.entropy_beta_final, epoch)
-        lr = sched(cfg.learning_rate, args.learning_rate_final, epoch)
+        beta = sched(cfg.entropy_beta, args.entropy_beta_final, epoch, beta_mode)
+        lr = sched(cfg.learning_rate, args.learning_rate_final, epoch, lr_mode)
+        lr, beta = live_hyper(lr, beta)
         t0 = time.time()
         metrics = None
         for _ in range(args.steps_per_epoch):
@@ -564,6 +592,17 @@ def _fused_epoch_loop(
             ep_count=step.put_batched(jnp.zeros(n_envs, jnp.int32)),
             ep_return_sum=step.put_batched(jnp.zeros(n_envs, jnp.float32)),
         )
+        if os.environ.get("BA3C_PARAM_DIGEST"):
+            # divergence detector for multi-host runs: ranks log this line
+            # per epoch; any mismatch across ranks means the psum'd update
+            # broke lockstep (costs a params device_get — debug only)
+            leaves = jax.tree_util.tree_leaves(
+                jax.device_get(state.train.params)
+            )
+            logger.info(
+                "param_digest %s",
+                " ".join(f"{np.float64(np.sum(l)):.10e}" for l in leaves),
+            )
         # greedy eval — the number the north-star (Pong >= 18) is defined on
         eval_mean = float("nan")
         if epoch % max(args.eval_every, 1) == 0:
@@ -582,6 +621,15 @@ def _fused_epoch_loop(
         holder.add_stat("fps", fps)
         if np.isfinite(mean_ret):
             holder.add_stat("mean_score", mean_ret)
+        if metrics["episodes"] > 0:
+            # approximate mean episode length: every env-step this epoch is
+            # a training step, so samples/episodes ≈ ep length (the timid-
+            # policy regression signature is this number climbing while
+            # eval falls — CoinRun diagnosis, BASELINE config #5)
+            holder.add_stat(
+                "ep_len_approx",
+                args.steps_per_epoch * samples_per_iter / metrics["episodes"],
+            )
         for k in ("loss", "policy_loss", "value_loss", "entropy", "grad_norm"):
             holder.add_stat(k, metrics[k])
         holder.finalize()

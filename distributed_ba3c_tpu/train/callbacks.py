@@ -270,10 +270,34 @@ class HyperParamSetterWithFunc(HyperParamSetter):
         )
 
 
+def read_hyper_file(path: str) -> Dict[str, float]:
+    """Parse a hyper.txt of ``name: value`` lines ({} if absent/unparsable).
+
+    Shared by HumanHyperParamSetter and the fused loop's live-override read
+    so every trainer accepts the same file format.
+    """
+    if not os.path.isfile(path):
+        return {}
+    try:
+        with open(path) as f:
+            return {
+                k.strip(): float(v)
+                for k, v in (line.split(":") for line in f if ":" in line)
+            }
+    except (ValueError, OSError):
+        logger.warn("could not parse %s", path)
+        return {}
+
+
 class HumanHyperParamSetter(HyperParamSetter):
     """Read ``<logdir>/<fname>`` lines of ``name: value`` each epoch.
 
     The reference's human-editable live hyperparam file (SURVEY.md §2.7 #21).
+    In multi-host runs only the CHIEF's read counts and the value is
+    broadcast — per-host reads racing a mid-run edit (or a lagging shared
+    FS) would hand hosts different values and silently diverge the psum'd
+    update. Safe collective-wise: every host builds the same setter list,
+    so the broadcasts align across ranks.
     """
 
     def __init__(
@@ -283,29 +307,35 @@ class HumanHyperParamSetter(HyperParamSetter):
         shared_dir: Optional[str] = None,
     ):
         """``shared_dir``: where to look for the file — in multi-host runs
-        pass the CHIEF's logdir so every host reads the SAME file (per-host
-        files would silently diverge the psum'd update)."""
+        pass the CHIEF's logdir (all hosts must agree on ONE file)."""
         super().__init__(name)
         self.fname = fname
         self.shared_dir = shared_dir
 
     def _value_to_set(self) -> Optional[float]:
+        import jax
+
+        if jax.process_count() > 1:
+            import numpy as _np
+            from jax.experimental import multihost_utils
+
+            v = float("nan")
+            if jax.process_index() == 0:
+                v0 = self._read_local()
+                v = float("nan") if v0 is None else v0
+            v = float(
+                multihost_utils.broadcast_one_to_all(
+                    _np.asarray(v, _np.float64)
+                )
+            )
+            return None if v != v else v
+        return self._read_local()
+
+    def _read_local(self) -> Optional[float]:
         log_dir = self.shared_dir or self.trainer.config.log_dir
         if log_dir is None:
             return None
-        path = os.path.join(log_dir, self.fname)
-        if not os.path.isfile(path):
-            return None
-        try:
-            with open(path) as f:
-                dic = {
-                    k.strip(): float(v)
-                    for k, v in (line.split(":") for line in f if ":" in line)
-                }
-            return dic.get(self.name)
-        except (ValueError, OSError):
-            logger.warn("could not parse %s", path)
-            return None
+        return read_hyper_file(os.path.join(log_dir, self.fname)).get(self.name)
 
 
 class StatPrinter(Callback):

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Launch a native env-server fleet on an ACTOR host (BASELINE config #3).
+
+The remote-actor topology: a learner runs `train.py --env zmq:<game>
+--pipe_c2s tcp://0.0.0.0:C --pipe_s2c tcp://0.0.0.0:S`; each actor host runs
+this script pointed at the learner. Every server process hosts up to 16
+native envs stepped in lockstep (envs/native.py CppEnvServerProcess), each
+env indistinguishable on the wire from a SimulatorProcess — the reference's
+remote simulators spoke the same ipc/tcp pipe pair (SURVEY.md §2.12 plane 1,
+expected RL/simulator.py).
+
+No jax in this process or its children: actor hosts need only numpy + pyzmq
++ the cpp/ shared object.
+
+Example (256 actors over 2 hosts, learner at 10.0.0.1):
+  actor-host-1$ python scripts/launch_env_fleet.py --game pong --n_envs 128 \
+      --c2s tcp://10.0.0.1:5555 --s2c tcp://10.0.0.1:5556 --base_idx 0
+  actor-host-2$ ... --base_idx 8   (distinct idx => distinct ZMQ identities)
+"""
+
+import argparse
+import signal
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--game", default="pong", help="native env name (cpp core)")
+    p.add_argument("--n_envs", type=int, default=64, help="total envs on this host")
+    p.add_argument("--c2s", required=True, help="learner's experience pipe, tcp://host:port")
+    p.add_argument("--s2c", required=True, help="learner's action pipe, tcp://host:port")
+    p.add_argument("--envs_per_proc", type=int, default=16)
+    p.add_argument("--frame_history", type=int, default=4)
+    p.add_argument(
+        "--base_idx", type=int, default=0,
+        help="first server index — MUST differ across actor hosts so ZMQ "
+        "identities (cppsim-<idx>-<env>) never collide",
+    )
+    args = p.parse_args(argv)
+
+    from distributed_ba3c_tpu.envs import native
+
+    if not native.available():
+        print("native env core not built: run `make -C cpp`", file=sys.stderr)
+        return 2
+
+    per = max(1, args.envs_per_proc)
+    procs = []
+    left = args.n_envs
+    i = args.base_idx
+    while left > 0:
+        procs.append(
+            native.CppEnvServerProcess(
+                i,
+                args.c2s,
+                args.s2c,
+                game=args.game,
+                n_envs=min(per, left),
+                frame_history=args.frame_history,
+            )
+        )
+        left -= per
+        i += 1
+    for pr in procs:
+        pr.start()
+    print(
+        f"fleet up: {args.n_envs} x {args.game} in {len(procs)} processes -> "
+        f"{args.c2s} / {args.s2c}",
+        flush=True,
+    )
+
+    stop = []
+    rc = 0
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *_: stop.append(1))
+    try:
+        while not stop:
+            for pr in procs:
+                if not pr.is_alive():
+                    # non-zero exit so a supervisor (systemd/k8s) restarts
+                    # the fleet instead of leaving the learner starved
+                    print(f"server {pr.name} died; shutting fleet down", file=sys.stderr)
+                    stop.append(1)
+                    rc = 1
+                    break
+            time.sleep(1.0)
+    finally:
+        for pr in procs:
+            pr.terminate()
+        for pr in procs:
+            pr.join(timeout=5)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -117,6 +117,40 @@ def run_fused_mode(rank: int, nprocs: int, coordinator: str, logdir: str) -> Non
     print(f"CLI_RC {rc}", flush=True)
 
 
+def run_soak_mode(
+    rank: int, nprocs: int, coordinator: str, logdir: str, max_epoch: int,
+    load: bool,
+) -> None:
+    """Fused trainer soak: schedules + live hyper.txt + per-epoch param
+    digests (BA3C_PARAM_DIGEST=1 set by the parent test). With ``load`` it
+    resumes from the shared checkpoint dir mid-soak."""
+    from distributed_ba3c_tpu.cli import main
+
+    hosts = ",".join([coordinator] + [f"x{i}:0" for i in range(1, nprocs)])
+    argv = [
+        "--trainer", "tpu_fused_ba3c",
+        "--env", "jax:pong",
+        "--worker_hosts", hosts,
+        "--task_index", str(rank),
+        "--batch_size", "8",
+        "--rollout_len", "2",
+        "--fc_units", "16",
+        "--steps_per_epoch", "2",
+        "--max_epoch", str(max_epoch),
+        "--nr_eval", "2",
+        "--eval_every", "3",
+        "--eval_max_steps", "8",
+        "--learning_rate_final", "1e-4",
+        "--entropy_beta_final", "1e-3",
+        "--anneal", "exp",
+        "--logdir", logdir,
+    ]
+    if load:
+        argv += ["--load", os.path.join(logdir, "checkpoints")]
+    rc = main(argv)
+    print(f"CLI_RC {rc}", flush=True)
+
+
 def run_cli_mode(
     rank: int, nprocs: int, coordinator: str, logdir: str, trainer=None
 ) -> None:
@@ -164,5 +198,10 @@ if __name__ == "__main__":
         )
     elif mode == "fused":
         run_fused_mode(rank, nprocs, coordinator, sys.argv[5])
+    elif mode == "soak":
+        run_soak_mode(
+            rank, nprocs, coordinator, sys.argv[5],
+            max_epoch=int(sys.argv[6]), load=sys.argv[7] == "load",
+        )
     else:
         run_step_mode(rank, nprocs, coordinator)

@@ -1,0 +1,105 @@
+"""Multi-host soak: sustained lockstep over epochs, not just one update.
+
+Two real processes run the fused trainer for many epochs with LR/β schedules
+active, per-epoch collective checkpoint saves, and a mid-soak resume from
+the shared checkpoint — while ``BA3C_PARAM_DIGEST=1`` makes every rank log a
+param digest each epoch. The digest sequences must be IDENTICAL across
+ranks for the whole run (the divergence modes a chief/shared-dir setup
+worries about: schedule drift, hyper.txt read races, restore mismatch).
+
+Phase B also proves the fused trainer honors live hyper.txt edits: with
+``learning_rate: 0`` written to the chief's dir before the resume, params
+must FREEZE — every phase-B digest equals the phase-A final digest.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(_WORKER))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = " ".join(
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env["BA3C_PARAM_DIGEST"] = "1"
+    return env
+
+
+def _run_pair(logdir: str, max_epoch: int, load: bool) -> list:
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, _WORKER, str(r), "2", coord, "soak",
+                logdir, str(max_epoch), "load" if load else "fresh",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=_env(),
+            cwd=os.path.dirname(os.path.dirname(_WORKER)),
+        )
+        for r in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+            assert p.returncode == 0, out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for out in outs:
+        assert "CLI_RC 0" in out, out
+    return outs
+
+
+def _digests(out: str) -> list:
+    return [
+        l.split("param_digest ", 1)[1]
+        for l in out.splitlines()
+        if "param_digest " in l
+    ]
+
+
+@pytest.mark.slow
+def test_soak_lockstep_with_schedules_hyper_and_resume(tmp_path):
+    logdir = str(tmp_path / "soak")
+
+    # phase A: 6 epochs with exp schedules + evals + collective ckpt saves
+    outs = _run_pair(logdir, max_epoch=6, load=False)
+    d0, d1 = (_digests(o) for o in outs)
+    assert len(d0) == 6, outs[0]
+    assert d0 == d1, "ranks diverged during the schedule soak"
+
+    # live-knob edit between phases: freeze the learner via hyper.txt
+    with open(os.path.join(logdir, "hyper.txt"), "w") as f:
+        f.write("learning_rate: 0.0\n")
+
+    # phase B: resume mid-soak from the SHARED checkpoint, 4 more epochs
+    outs = _run_pair(logdir, max_epoch=10, load=True)
+    b0, b1 = (_digests(o) for o in outs)
+    assert len(b0) == 4, outs[0]
+    assert b0 == b1, "ranks diverged after the mid-soak resume"
+    # hyper.txt took effect in the fused trainer: lr=0 froze the params,
+    # so every post-resume digest equals the pre-resume final digest
+    assert all(d == d0[-1] for d in b0), (d0[-1], b0)

@@ -1,0 +1,93 @@
+"""Remote actor fleets over tcp:// (BASELINE config #3's topology).
+
+A learner with NO local simulators (`--env zmq:pong`) binds its master pipes
+on tcp://127.0.0.1; an env-server fleet launched by scripts/launch_env_fleet.py
+— a separate process tree, exactly what an actor host runs — connects over
+TCP and feeds it. The learner must complete its epoch budget on fleet
+experience alone and write its stats. Reference: SURVEY.md §2.12 plane 1
+(remote simulators on the reference's ipc/tcp pipe pair).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from distributed_ba3c_tpu.envs import native
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not native.available(), reason="cpp core not built")
+def test_learner_trains_on_remote_tcp_fleet(tmp_path):
+    logdir = str(tmp_path / "log")
+    c2s = f"tcp://127.0.0.1:{_free_port()}"
+    s2c = f"tcp://127.0.0.1:{_free_port()}"
+
+    learner = subprocess.Popen(
+        [
+            sys.executable, os.path.join(_ROOT, "train.py"),
+            "--env", "zmq:pong",
+            "--pipe_c2s", c2s.replace("127.0.0.1", "0.0.0.0"),
+            "--pipe_s2c", s2c.replace("127.0.0.1", "0.0.0.0"),
+            "--batch_size", "16",
+            "--fc_units", "16",
+            "--steps_per_epoch", "5",
+            "--max_epoch", "1",
+            "--nr_eval", "0",
+            "--logdir", logdir,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=_env(),
+        cwd=_ROOT,
+    )
+    fleet = subprocess.Popen(
+        [
+            sys.executable, os.path.join(_ROOT, "scripts/launch_env_fleet.py"),
+            "--game", "pong",
+            "--n_envs", "32",
+            "--c2s", c2s,
+            "--s2c", s2c,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=_env(),
+        cwd=_ROOT,
+    )
+    try:
+        out, _ = learner.communicate(timeout=420)
+        assert learner.returncode == 0, out
+    finally:
+        fleet.terminate()
+        try:
+            fleet.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            fleet.kill()
+        if learner.poll() is None:
+            learner.kill()
+
+    stats = json.load(open(os.path.join(logdir, "stat.json")))
+    assert stats and stats[-1]["global_step"] == 5
+    # fleet episodes really flowed back (pong always scores within the cap)
+    fout = fleet.communicate()[0]
+    assert "fleet up: 32 x pong" in fout
