@@ -200,12 +200,14 @@ def bench_shape(n_envs: int, rollout_len: int):
     )
 
 
-def bench_attribution(n_envs: int, rollout_len: int):
+def bench_attribution(n_envs: int, rollout_len: int, inner: int = 50):
     """Close the full-vs-parts gap (VERDICT r2 #3): price the returns scan,
     the Adam+clip update, and the episode bookkeeping individually, so
     full - (rollout + learner + returns + adam + bookkeeping) is a measured
-    residual, not a guess. Components are chained through carried state so
-    the tunnel cannot pipeline-hide them."""
+    residual, not a guess. Each component repeats ``inner`` times INSIDE one
+    jitted lax.scan with threaded carries — per-dispatch tunnel latency
+    (~10ms/call on the dev link, larger than the components themselves)
+    divides out, and the chain is unfoldable so XLA cannot elide it."""
     cfg = BA3CConfig(num_actions=pong.num_actions)
     model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
     opt = make_optimizer(cfg.learning_rate, cfg.adam_epsilon, cfg.grad_clip_norm)
@@ -216,27 +218,30 @@ def bench_attribution(n_envs: int, rollout_len: int):
     T, B = rollout_len, n_envs
     steps = T * B
 
-    def timeit_chained(fn, carry, iters=20):
-        carry = fn(carry)
-        jax.block_until_ready(carry)
+    def time_scanned(jitted, carry, outer=5):
+        out = jitted(carry)
+        jax.block_until_ready(out)
         t0 = time.perf_counter()
-        for _ in range(iters):
-            carry = fn(carry)
-        jax.block_until_ready(carry)
-        return (time.perf_counter() - t0) / iters
+        for _ in range(outer):
+            out = jitted(out)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / (outer * inner)
 
     # -- n-step discounted returns scan on [T, B] --------------------------
     from distributed_ba3c_tpu.ops.returns import n_step_returns
 
     @jax.jit
-    def returns_only(carry):
-        rew, done, boot = carry
-        ret = n_step_returns(rew, done, boot, cfg.gamma)
-        # thread outputs back into inputs: unfoldable chain
-        return rew + 1e-9 * ret, done, boot + 1e-9 * ret[-1]
+    def returns_rep(carry):
+        def body(c, _):
+            rew, done, boot = c
+            ret = n_step_returns(rew, done, boot, cfg.gamma)
+            # thread outputs back into inputs: unfoldable chain
+            return (rew + 1e-9 * ret, done, boot + 1e-9 * ret[-1]), None
+        out, _ = jax.lax.scan(body, carry, None, length=inner)
+        return out
 
-    t_ret = timeit_chained(
-        returns_only,
+    t_ret = time_scanned(
+        returns_rep,
         (
             jnp.zeros((T, B), jnp.float32),
             jnp.zeros((T, B), jnp.bool_),
@@ -245,38 +250,48 @@ def bench_attribution(n_envs: int, rollout_len: int):
     )
 
     # -- Adam + global-norm clip update alone ------------------------------
+    import optax
+
     opt_state = opt.init(params)
     grads = jax.tree_util.tree_map(lambda p: jnp.ones_like(p) * 1e-9, params)
 
     @jax.jit
-    def adam_only(carry):
-        p, os_ = carry
-        import optax
+    def adam_rep(carry):
+        def body(c, _):
+            p, os_ = c
+            # derive grads from the CARRY so the global-norm reduction and
+            # clip scaling are iteration-dependent — loop-invariant grads
+            # would let XLA hoist the clip out of the scan
+            g = jax.tree_util.tree_map(lambda gl, pl: gl + 1e-12 * pl, grads, p)
+            updates, os_ = opt.update(g, os_, p)
+            return (optax.apply_updates(p, updates), os_), None
+        out, _ = jax.lax.scan(body, carry, None, length=inner)
+        return out
 
-        updates, os_ = opt.update(grads, os_, p)
-        return optax.apply_updates(p, updates), os_
-
-    t_adam = timeit_chained(adam_only, (params, opt_state))
+    t_adam = time_scanned(adam_rep, (params, opt_state))
 
     # -- episode bookkeeping (the where/accumulate plane on [T, B]) --------
     @jax.jit
-    def bookkeeping_only(carry):
-        ep_ret, ep_count, ep_sum, rew, done = carry
-        def body(c, td):
-            er, cnt, s = c
-            r, d = td
-            er = er + r
-            cnt = cnt + d.astype(jnp.int32)
-            s = s + jnp.where(d, er, 0.0)
-            er = jnp.where(d, 0.0, er)
-            return (er, cnt, s), None
-        (ep_ret, ep_count, ep_sum), _ = jax.lax.scan(
-            body, (ep_ret, ep_count, ep_sum), (rew, done)
-        )
-        return ep_ret, ep_count, ep_sum, rew + 1e-9 * ep_ret, done
+    def book_rep(carry):
+        def rep(c, _):
+            ep_ret, ep_count, ep_sum, rew, done = c
+            def body(cc, td):
+                er, cnt, s = cc
+                r, d = td
+                er = er + r
+                cnt = cnt + d.astype(jnp.int32)
+                s = s + jnp.where(d, er, 0.0)
+                er = jnp.where(d, 0.0, er)
+                return (er, cnt, s), None
+            (ep_ret, ep_count, ep_sum), _ = jax.lax.scan(
+                body, (ep_ret, ep_count, ep_sum), (rew, done)
+            )
+            return (ep_ret, ep_count, ep_sum, rew + 1e-9 * ep_ret, done), None
+        out, _ = jax.lax.scan(rep, carry, None, length=inner)
+        return out
 
-    t_book = timeit_chained(
-        bookkeeping_only,
+    t_book = time_scanned(
+        book_rep,
         (
             jnp.zeros(B, jnp.float32),
             jnp.zeros(B, jnp.int32),
