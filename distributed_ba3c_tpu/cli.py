@@ -65,7 +65,9 @@ def make_parser() -> argparse.ArgumentParser:
     # -- loop shape --------------------------------------------------------
     p.add_argument("--steps_per_epoch", type=int, default=1000)
     p.add_argument("--max_epoch", type=int, default=100)
-    p.add_argument("--nr_eval", type=int, default=8)
+    # None sentinel so external-fleet mode can tell an EXPLICIT --nr_eval
+    # (worth a warning when dropped) from the default
+    p.add_argument("--nr_eval", type=int, default=None)
     p.add_argument("--eval_every", type=int, default=1, help="epochs between Evaluator runs")
     p.add_argument("--eval_max_steps", type=int, default=10000, help="greedy-eval step horizon (fused trainer; must cover a full episode)")
     p.add_argument("--num_actions", type=int, default=4)
@@ -82,6 +84,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--profiler_port", type=int, default=0, help="start jax.profiler server on this port (0=off)")
     p.add_argument("--pipe_c2s", default=None, help="master experience-plane bind address, e.g. tcp://0.0.0.0:5555 (default: per-pid ipc://)")
     p.add_argument("--pipe_s2c", default=None, help="master action-plane bind address, e.g. tcp://0.0.0.0:5556 (default: per-pid ipc://)")
+    p.add_argument("--max_to_keep", type=int, default=3, help="checkpoints retained (besides best); raise to keep every eval-epoch checkpoint for post-hoc crossing verification")
+    p.add_argument("--steps_per_dispatch", type=int, default=1, help="fused trainer: wrap K update steps in one lax.scan program (one host dispatch per K updates; must divide --steps_per_epoch). Removes per-step dispatch overhead without relying on host pipelining")
+    p.add_argument("--rank_stall_timeout", type=float, default=0, help="multi-host: seconds without epoch progress before a rank declares a peer dead and exits 75 (0 = default 600s when multi-host; must exceed the slowest epoch incl. first compile). Relaunch with --load to resume")
+    p.add_argument("--seed", type=int, default=0, help="fused trainer: PRNG seed for params/envs/action sampling (whole-trajectory determinism per seed; multi-seed runs disclose seed selection in RESULTS.md)")
     return p
 
 
@@ -184,6 +190,9 @@ def _build_player_factory(args, cfg: BA3CConfig):
 
 def main(argv: Optional[list] = None) -> int:
     args = make_parser().parse_args(argv)
+    nr_eval_explicit = args.nr_eval is not None
+    if args.nr_eval is None:
+        args.nr_eval = 8
 
     if args.job_name == "ps":
         print(
@@ -404,6 +413,14 @@ def main(argv: Optional[list] = None) -> int:
     # reference MaxSaver kept the Evaluator's best); otherwise fall back to
     # the sampling-policy mean.
     run_eval = chief and args.nr_eval > 0 and build_player is not None
+    if chief and nr_eval_explicit and args.nr_eval > 0 and build_player is None:
+        # external-fleet mode (--env zmq:) has no local player to evaluate
+        # with: say so instead of silently changing the keep-best policy
+        logger.warn(
+            "--nr_eval %d ignored: no local player in --env %s mode; "
+            "MaxSaver keep-best falls back to the sampling-policy mean_score",
+            args.nr_eval, args.env,
+        )
     callbacks = [
         StartProcOrThread([predictor, master, feed] + procs),
         HumanHyperParamSetter("learning_rate", shared_dir=base_logdir),
@@ -411,7 +428,10 @@ def main(argv: Optional[list] = None) -> int:
         StatPrinter(),
         # ONE checkpoint dir for every host: orbax saves are collective and
         # must target the same path on all processes
-        ModelSaver(ckpt_dir=os.path.join(base_logdir, "checkpoints")),
+        ModelSaver(
+            ckpt_dir=os.path.join(base_logdir, "checkpoints"),
+            max_to_keep=args.max_to_keep,
+        ),
         MaxSaver(monitor="eval_mean_score" if run_eval else "mean_score"),
     ]
     if run_eval:
@@ -454,6 +474,7 @@ def main(argv: Optional[list] = None) -> int:
             max_epoch=args.max_epoch,
             log_dir=args.logdir,
             publish_every=args.publish_every,
+            rank_stall_timeout=args.rank_stall_timeout,
         ),
         cfg,
         step,

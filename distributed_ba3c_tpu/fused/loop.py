@@ -100,6 +100,7 @@ def make_fused_step(
     env,
     rollout_len: int = 20,
     grad_chunk_samples: int = 4096,
+    steps_per_dispatch: int = 1,
 ) -> Callable:
     """Build fn(state, entropy_beta, lr) -> (state, metrics), fully on-device.
 
@@ -108,6 +109,15 @@ def make_fused_step(
     the full fused program, 10240 OOMs; throughput is flat across 1024-5120
     (the convs' MXU utilization is channel-count-bound, not batch-bound), so
     the default stays comfortably under the cliff.
+
+    ``steps_per_dispatch`` > 1 wraps that many full update steps in one
+    ``lax.scan`` inside the jitted program: one host dispatch per K updates.
+    At small per-step programs (the flagship 128x20 shape runs ~13 ms of
+    device work) the per-dispatch host/tunnel overhead is a real tax unless
+    host pipelining hides it; scanning removes the dependence on pipelining
+    entirely (PERF.md round 4). β/lr are scan-carried scalars, so one
+    dispatch spans only steps sharing a hyperparam setting (the epoch loop
+    already changes them per epoch only).
     """
 
     def local_step(state: FusedState, entropy_beta, learning_rate):
@@ -266,6 +276,24 @@ def make_fused_step(
         metrics["episode_return_sum"] = jax.lax.psum(jnp.sum(ep_sum), DATA_AXIS)
         return new_state, metrics
 
+    def multi_step(state: FusedState, entropy_beta, learning_rate):
+        if steps_per_dispatch == 1:
+            return local_step(state, entropy_beta, learning_rate)
+
+        def body(s, _):
+            return local_step(s, entropy_beta, learning_rate)
+
+        state, ms = jax.lax.scan(body, state, None, length=steps_per_dispatch)
+        # episode counters are cumulative-in-state (reset once per epoch by
+        # the outer loop), so the LAST step's psum is "episodes so far";
+        # loss-like metrics average over the dispatch window
+        last = ("episodes", "episode_return_sum")
+        metrics = {
+            k: (v[-1] if k in last else jnp.mean(v, axis=0))
+            for k, v in ms.items()
+        }
+        return state, metrics
+
     batch_spec = P(DATA_AXIS)
     env_state_struct = jax.eval_shape(env.reset, jax.random.PRNGKey(0))
     # pytree-prefix specs: train=P() replicates the whole TrainState subtree
@@ -280,7 +308,7 @@ def make_fused_step(
     )
 
     sharded = jax.shard_map(
-        local_step,
+        multi_step,
         mesh=mesh,
         in_specs=(state_specs, P(), P()),
         out_specs=(state_specs, P()),
@@ -336,6 +364,7 @@ def make_fused_step(
     step.batch_sharding = batched
     step.mesh = mesh
     step.rollout_len = rollout_len
+    step.steps_per_dispatch = steps_per_dispatch
     return step
 
 
@@ -461,25 +490,59 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
     rollout_len = args.rollout_len
     envs_per_device = max(1, cfg.batch_size // rollout_len)
     n_envs = envs_per_device * n_data
+    k_dispatch = max(1, getattr(args, "steps_per_dispatch", 1))
+    if args.steps_per_epoch % k_dispatch:
+        raise SystemExit(
+            f"--steps_per_dispatch {k_dispatch} must divide "
+            f"--steps_per_epoch {args.steps_per_epoch}"
+        )
     step = make_fused_step(
         model, optimizer, cfg, mesh, env, rollout_len,
         grad_chunk_samples=args.grad_chunk_samples,
+        steps_per_dispatch=k_dispatch,
     )
     state = create_fused_state(
-        jax.random.PRNGKey(0), model, cfg, optimizer, env, n_envs, n_shards=n_data
+        jax.random.PRNGKey(getattr(args, "seed", 0) or 0),
+        model, cfg, optimizer, env, n_envs, n_shards=n_data,
     )
     if args.load:
         mgr = CheckpointManager(args.load)
         restored = mgr.restore(jax.device_get(state.train))
         state = state.replace(train=restored)
         logger.info("resumed train state at step %d", int(restored.step))
+    run_shape = {
+        "steps_per_epoch": args.steps_per_epoch,
+        "batch_size": cfg.batch_size,
+        "rollout_len": rollout_len,
+        "max_epoch": args.max_epoch,
+    }
+    shape_mismatch = False
+    if args.load:
+        # schedule-shape guard: the resumed epoch counter is
+        # step // steps_per_epoch, so a different shape silently stretches
+        # or shifts the anneal — warn loudly when the shapes disagree
+        prev = mgr.read_run_meta()
+        for k, v in run_shape.items():
+            if k in prev and prev[k] != v:
+                shape_mismatch = True
+                logger.warn(
+                    "resume shape mismatch: %s was %s at save time, now %s — "
+                    "the LR/beta anneal will NOT continue where it left off",
+                    k, prev[k], v,
+                )
     state = step.put(state)
 
     holder = StatHolder(args.logdir)
     # one SHARED checkpoint dir across hosts (orbax saves are collective)
     ckpt = CheckpointManager(
-        getattr(args, "shared_ckpt_dir", None) or f"{args.logdir}/checkpoints"
+        getattr(args, "shared_ckpt_dir", None) or f"{args.logdir}/checkpoints",
+        max_to_keep=getattr(args, "max_to_keep", 3),
     )
+    if not shape_mismatch:
+        # on a MISMATCHED resume, keep the original shape on record so the
+        # warning keeps firing on every later resume (overwriting here
+        # would mute the guard after its first catch)
+        ckpt.write_run_meta(**run_shape)
     logger.set_logger_dir(args.logdir)
     samples_per_iter = n_envs * rollout_len
     logger.info(
@@ -528,7 +591,6 @@ def _fused_epoch_loop(
 ):
     from distributed_ba3c_tpu.utils import logger
 
-    best = -np.inf
     # Resume CONTINUES the schedule: the epoch counter derives from the
     # restored global step, so a stall-kill + --load (run_with_resume.sh)
     # picks up the anneal where it left off instead of restarting it —
@@ -571,13 +633,39 @@ def _fused_epoch_loop(
 
     beta_mode = getattr(args, "anneal_beta", None)
     lr_mode = getattr(args, "anneal_lr", None)
+    # rank-failure detection (SURVEY §5): in multi-host runs a dead peer
+    # wedges this rank in the next psum/save barrier forever — the watchdog
+    # turns that undefined hang into a bounded-time nonzero exit so the
+    # launcher can relaunch every rank with --load on the shared checkpoints
+    from distributed_ba3c_tpu.parallel.watchdog import (
+        LockstepWatchdog,
+        resolve_timeout,
+    )
+
+    with LockstepWatchdog(
+        resolve_timeout(getattr(args, "rank_stall_timeout", 0)),
+        what=f"rank {jax.process_index()}/{jax.process_count()} epoch loop",
+    ) as watchdog:
+        _fused_epoch_body(
+            args, cfg, step, state, holder, ckpt, samples_per_iter, n_envs,
+            sched, evaluate, epoch0, live_hyper, beta_mode, lr_mode, watchdog,
+        )
+
+
+def _fused_epoch_body(
+    args, cfg, step, state, holder, ckpt, samples_per_iter, n_envs, sched,
+    evaluate, epoch0, live_hyper, beta_mode, lr_mode, watchdog,
+):
+    from distributed_ba3c_tpu.utils import logger
+
+    best = -np.inf
     for epoch in range(epoch0 + 1, args.max_epoch + 1):
         beta = sched(cfg.entropy_beta, args.entropy_beta_final, epoch, beta_mode)
         lr = sched(cfg.learning_rate, args.learning_rate_final, epoch, lr_mode)
         lr, beta = live_hyper(lr, beta)
         t0 = time.time()
         metrics = None
-        for _ in range(args.steps_per_epoch):
+        for _ in range(args.steps_per_epoch // step.steps_per_dispatch):
             state, metrics = step(state, beta, lr)
         metrics = {k: float(v) for k, v in metrics.items()}
         dt = time.time() - t0
@@ -649,3 +737,6 @@ def _fused_epoch_loop(
         if np.isfinite(eval_mean) and eval_mean > best:
             best = eval_mean
             ckpt.mark_best(int(state.train.step), eval_mean)
+        # global progress proven (metrics fetched + collective save done):
+        # re-arm the rank-failure watchdog for the next epoch
+        watchdog.beat()

@@ -115,3 +115,64 @@ def test_fused_episode_accounting(fused):
         assert -21.0 <= mean_ret <= 21.0
     # ep_return accumulators stay bounded
     assert np.all(np.abs(np.asarray(state.ep_return)) <= 21.0 + 1e-6)
+
+
+def test_scanned_dispatch_matches_sequential_steps(fused_setup):
+    """steps_per_dispatch=K parity against K sequential dispatches.
+
+    With learning_rate=0 the params are frozen, so both variants consume the
+    IDENTICAL key sequence and must produce bit-identical env trajectories,
+    frame stacks, and episode counters — exercising the whole scan plumbing.
+    (With a live lr, bit-equality across differently-compiled programs is
+    not a sound contract: XLA fuses the scan body differently, a 1-ulp logit
+    change flips a sampled action, and the RL trajectory is chaotic.)"""
+    cfg, step, make_state, n_envs = fused_setup
+    mesh = make_mesh()
+    n_data = mesh.shape["data"]
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    opt = make_optimizer(cfg.learning_rate, cfg.adam_epsilon, cfg.grad_clip_norm)
+    K = 4
+    step_k = make_fused_step(
+        model, opt, cfg, mesh, pong, rollout_len=3, steps_per_dispatch=K
+    )
+
+    def fresh(putter):
+        return putter(
+            create_fused_state(
+                jax.random.PRNGKey(0), model, cfg, opt, pong, n_envs,
+                n_shards=n_data,
+            )
+        )
+
+    # --- lr=0: params frozen => trajectories must be bit-identical ---
+    state_seq = fresh(step.put)
+    for _ in range(K):
+        state_seq, m_seq = step(state_seq, cfg.entropy_beta, learning_rate=0.0)
+    state_scan = fresh(step_k.put)
+    state_scan, m_scan = step_k(state_scan, cfg.entropy_beta, learning_rate=0.0)
+
+    assert int(state_scan.train.step) == int(state_seq.train.step) == K
+    np.testing.assert_array_equal(
+        np.asarray(state_seq.obs_stack), np.asarray(state_scan.obs_stack)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state_seq.ep_count), np.asarray(state_scan.ep_count)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(state_seq.ep_return), np.asarray(state_scan.ep_return)
+    )
+    # cumulative counters: scan's LAST-step metric == sequential's last
+    assert float(m_scan["episodes"]) == float(m_seq["episodes"])
+    assert float(m_scan["episode_return_sum"]) == float(
+        m_seq["episode_return_sum"]
+    )
+
+    # --- live lr: the scanned program must actually train ---
+    state_live = fresh(step_k.put)
+    p0 = np.asarray(jax.tree_util.tree_leaves(state_live.train.params)[0]).copy()
+    state_live, m_live = step_k(state_live, cfg.entropy_beta)
+    assert int(state_live.train.step) == K
+    p1 = np.asarray(jax.tree_util.tree_leaves(state_live.train.params)[0])
+    assert not np.array_equal(p0, p1), "scanned dispatch did not update params"
+    for k, v in m_live.items():
+        assert np.isfinite(float(v)), k
