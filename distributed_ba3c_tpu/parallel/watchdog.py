@@ -1,0 +1,117 @@
+"""Lockstep liveness watchdog for the multi-host gradient plane.
+
+Failure model (SURVEY.md §5 failure detection — the reference had NONE on
+its gRPC parameter-server plane; this plane defines the semantics instead of
+inheriting an undefined hang): every rank of a multi-host fused run executes
+the same jitted program in lockstep, synchronized by the psum inside the
+update and by collective orbax saves. When ONE rank dies (OOM-kill, host
+loss, SIGKILL), every survivor blocks forever inside the next collective —
+the Python loop cannot observe the stall from inside, because dispatches are
+async and the block happens in the runtime.
+
+So detection is out-of-band: a daemon thread armed with a deadline. The
+training loop calls ``beat()`` at every epoch boundary (the one place the
+loop provably made global progress — the metrics fetch forces the epoch's
+collectives to completion). If no beat lands within ``timeout_s``, the
+watchdog logs the diagnosis and hard-exits the process with code 75
+(EX_TEMPFAIL: transient, retry-able). ``os._exit`` is deliberate — the main
+thread is wedged in a collective and cannot unwind; a clean shutdown is
+impossible by construction.
+
+Recovery contract: every rank exits nonzero within ``timeout_s`` of the
+failure; the launcher relaunches the job with ``--load <shared ckpt dir>``
+and the run CONTINUES its schedule (fused resume derives the epoch from the
+restored step). Proven end-to-end by ``tests/test_rank_failure.py``, which
+SIGKILLs one of two ranks mid-soak and then completes the run by resuming.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from distributed_ba3c_tpu.utils import logger
+
+EXIT_CODE = 75  # EX_TEMPFAIL: lockstep lost, relaunch with --load to resume
+DEFAULT_TIMEOUT_S = 600.0
+
+
+def resolve_timeout(configured: float) -> float:
+    """The one place the arming policy lives: multi-host runs get
+    ``configured`` seconds (or the 600s default when unset/<=0); single-host
+    runs get 0 (disabled — the external stall launcher owns that case)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return 0.0
+    return float(configured) if configured and configured > 0 else DEFAULT_TIMEOUT_S
+
+
+class LockstepWatchdog:
+    """Hard-exit the process if ``beat()`` stalls for ``timeout_s``.
+
+    Use as a context manager around the epoch loop; ``beat()`` after each
+    epoch's metrics fetch. ``timeout_s`` must exceed the slowest epoch
+    (first-compile epochs included) — it bounds failure DETECTION latency,
+    not epoch time.
+    """
+
+    def __init__(
+        self,
+        timeout_s: float,
+        what: str = "multi-host lockstep",
+        first_timeout_s: float | None = None,
+    ):
+        self.timeout_s = float(timeout_s)
+        # the FIRST epoch includes the XLA compile (tens of seconds); before
+        # the first beat the deadline is therefore more generous, or a
+        # healthy rank would suicide mid-compile
+        self.first_timeout_s = (
+            float(first_timeout_s)
+            if first_timeout_s is not None
+            else 3.0 * self.timeout_s
+        )
+        self.what = what
+        self._last = time.monotonic()
+        self._beaten = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+        self._beaten = True
+
+    def _watch(self) -> None:
+        while not self._stop.wait(min(self.timeout_s / 4, 5.0)):
+            limit = self.timeout_s if self._beaten else self.first_timeout_s
+            stalled = time.monotonic() - self._last
+            if stalled > limit:
+                logger.error(
+                    "%s stalled %.0fs (> %.0fs): a peer rank likely died — "
+                    "this rank is blocked in a collective and cannot "
+                    "recover in-place. Exiting %d; relaunch all ranks with "
+                    "--load on the shared checkpoint dir to resume.",
+                    self.what, stalled, self.timeout_s, EXIT_CODE,
+                )
+                # flush logs before the hard exit
+                for h in getattr(logger._LOGGER, "handlers", []):
+                    try:
+                        h.flush()
+                    except Exception:
+                        pass
+                os._exit(EXIT_CODE)
+
+    def __enter__(self) -> "LockstepWatchdog":
+        if self.timeout_s > 0:
+            self._thread = threading.Thread(
+                target=self._watch, name="lockstep-watchdog", daemon=True
+            )
+            self._last = time.monotonic()
+            self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

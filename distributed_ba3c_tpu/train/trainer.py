@@ -40,6 +40,9 @@ class TrainLoopConfig:
     log_dir: Optional[str] = None
     publish_every: int = 1  # params → predictor every N steps
     feed_timeout: float = 120.0
+    # multi-host only: secs without epoch progress before declaring a peer
+    # rank dead and exiting 75 (0 → 600s default when process_count > 1)
+    rank_stall_timeout: float = 0.0
 
 
 class Trainer:
@@ -157,11 +160,25 @@ class Trainer:
             logger.set_logger_dir(self.config.log_dir)
         self._callbacks.before_train()
         self._publish_params()
+        # multi-host rank-failure detection (SURVEY §5): a dead peer wedges
+        # this rank in the next psum forever; the watchdog converts that into
+        # a bounded-time exit 75 so the launcher can resume from checkpoints
+        from distributed_ba3c_tpu.parallel.watchdog import (
+            LockstepWatchdog,
+            resolve_timeout,
+        )
+
         try:
-            for self.epoch_num in range(1, self.config.max_epoch + 1):
-                for _ in range(self.config.steps_per_epoch):
-                    self.run_step()
-                self._callbacks.trigger_epoch()
+            with LockstepWatchdog(
+                resolve_timeout(getattr(self.config, "rank_stall_timeout", 0)),
+                what=f"rank {jax.process_index()}/{jax.process_count()} "
+                "epoch loop",
+            ) as watchdog:
+                for self.epoch_num in range(1, self.config.max_epoch + 1):
+                    for _ in range(self.config.steps_per_epoch):
+                        self.run_step()
+                    self._callbacks.trigger_epoch()
+                    watchdog.beat()
         except KeyboardInterrupt:
             logger.warn("training interrupted")
         except queue.Empty:

@@ -27,8 +27,46 @@ EOF
 fi
 
 echo "[launch] worker_hosts=$WORKER_HOSTS task_index=$TASK_INDEX"
-exec python train.py \
-  --job_name worker \
-  --worker_hosts "$WORKER_HOSTS" \
-  --task_index "$TASK_INDEX" \
-  "$@"
+# Rank-failure semantics (parallel/watchdog.py): if a peer rank dies, every
+# survivor exits 75 within --rank_stall_timeout (default 600s). Exit 75 is
+# retry-able: loop a relaunch that RESUMES from the run's shared checkpoint
+# dir instead of stranding the allocation (README 'Rank-failure semantics').
+LOGDIR=""
+CALLER_LOADS=0
+prev=""
+for a in "$@"; do
+  case "$a" in
+    --logdir=*) LOGDIR="${a#--logdir=}" ;;
+    --load|--load=*) CALLER_LOADS=1 ;;
+  esac
+  if [[ "$prev" == "--logdir" ]]; then LOGDIR="$a"; fi
+  prev="$a"
+done
+relaunch=0
+while :; do
+  args=("$@")
+  # resume ONLY on relaunch after a lost-lockstep exit: the first launch
+  # keeps fresh-start semantics even over a reused logdir (a silent
+  # auto-resume there could "complete" a finished run with zero training)
+  if [[ $relaunch -eq 1 && $CALLER_LOADS -eq 0 ]]; then
+    if [[ -n "$LOGDIR" && -d "$LOGDIR/checkpoints" ]]; then
+      args+=(--load "$LOGDIR/checkpoints")
+    else
+      echo "[launch] exit 75 but no checkpoint dir to resume from" \
+        "(logdir='$LOGDIR') — relaunching fresh" >&2
+    fi
+  fi
+  set +e
+  python train.py \
+    --job_name worker \
+    --worker_hosts "$WORKER_HOSTS" \
+    --task_index "$TASK_INDEX" \
+    "${args[@]}"
+  rc=$?
+  set -e
+  if [[ $rc -ne 75 ]]; then
+    exit $rc
+  fi
+  relaunch=1
+  echo "[launch] rank lost lockstep (exit 75) — relaunching with resume" >&2
+done

@@ -119,7 +119,7 @@ def run_fused_mode(rank: int, nprocs: int, coordinator: str, logdir: str) -> Non
 
 def run_soak_mode(
     rank: int, nprocs: int, coordinator: str, logdir: str, max_epoch: int,
-    load: bool,
+    load: bool, rank_stall_timeout: float = 0.0,
 ) -> None:
     """Fused trainer soak: schedules + live hyper.txt + per-epoch param
     digests (BA3C_PARAM_DIGEST=1 set by the parent test). With ``load`` it
@@ -128,6 +128,7 @@ def run_soak_mode(
 
     hosts = ",".join([coordinator] + [f"x{i}:0" for i in range(1, nprocs)])
     argv = [
+        "--rank_stall_timeout", str(rank_stall_timeout),
         "--trainer", "tpu_fused_ba3c",
         "--env", "jax:pong",
         "--worker_hosts", hosts,
@@ -202,6 +203,9 @@ if __name__ == "__main__":
         run_soak_mode(
             rank, nprocs, coordinator, sys.argv[5],
             max_epoch=int(sys.argv[6]), load=sys.argv[7] == "load",
+            rank_stall_timeout=(
+                float(sys.argv[8]) if len(sys.argv) > 8 else 0.0
+            ),
         )
     else:
         run_step_mode(rank, nprocs, coordinator)

@@ -1,0 +1,188 @@
+"""Rank-failure semantics for the multi-host gradient plane.
+
+The reference had NO failure detection on its parameter-server plane
+(SURVEY.md §5: a dead worker just stalled the queue). This framework defines
+the semantics: when a rank dies, every survivor — wedged in the next
+psum/save barrier — exits nonzero within a bounded time (LockstepWatchdog,
+parallel/watchdog.py), and relaunching all ranks with ``--load`` on the
+shared checkpoint dir resumes the run's schedule to completion.
+
+Two layers:
+- a fast unit test that the watchdog thread itself fires (and that beats
+  defer it) — in a subprocess, since firing is ``os._exit(75)``;
+- a slow end-to-end test that SIGKILLs one of two real jax.distributed
+  ranks mid-soak, asserts the survivor's bounded-time nonzero exit, then
+  completes the run by resuming both ranks from the shared checkpoints.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+_REPO = os.path.dirname(os.path.dirname(_WORKER))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = " ".join(
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env["BA3C_PARAM_DIGEST"] = "1"
+    return env
+
+
+def _digests(out: str) -> list:
+    return [
+        l.split("param_digest ", 1)[1]
+        for l in out.splitlines()
+        if "param_digest " in l
+    ]
+
+
+def test_watchdog_fires_exit75_and_beats_defer():
+    """Unit semantics in a subprocess: beats keep it alive past several
+    timeouts; stopping the beats makes it exit EXIT_CODE promptly."""
+    code = r"""
+import sys, time
+sys.path.insert(0, %r)
+from distributed_ba3c_tpu.parallel.watchdog import LockstepWatchdog, EXIT_CODE
+with LockstepWatchdog(1.0, what="unit") as wd:
+    for _ in range(8):          # 2s of life > 2 timeouts, held by beats
+        time.sleep(0.25)
+        wd.beat()
+    print("BEATS_HELD", flush=True)
+    time.sleep(30)              # no more beats: watchdog must fire
+print("UNREACHABLE", flush=True)
+""" % (_REPO,)
+    t0 = time.monotonic()
+    p = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=60, env=_env(),
+    )
+    dt = time.monotonic() - t0
+    assert "BEATS_HELD" in p.stdout, p.stdout + p.stderr
+    assert "UNREACHABLE" not in p.stdout
+    assert p.returncode == 75, (p.returncode, p.stdout, p.stderr)
+    assert dt < 20, f"watchdog took {dt:.1f}s to fire a 1s timeout"
+
+
+def _spawn_soak(rank, coord, logdir, max_epoch, load, stall_timeout):
+    return subprocess.Popen(
+        [
+            sys.executable, _WORKER, str(rank), "2", coord, "soak",
+            logdir, str(max_epoch), "load" if load else "fresh",
+            str(stall_timeout),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=_env(),
+        cwd=_REPO,
+    )
+
+
+@pytest.mark.slow
+def test_rank_death_bounded_exit_then_resume_completes(tmp_path):
+    logdir = str(tmp_path / "soak")
+    coord = f"127.0.0.1:{_free_port()}"
+    stall_timeout = 40.0
+    max_epoch = 8
+
+    p0 = _spawn_soak(0, coord, logdir, max_epoch, False, stall_timeout)
+    p1 = _spawn_soak(1, coord, logdir, max_epoch, False, stall_timeout)
+
+    # stream rank 0's output so we can kill rank 1 only after real progress
+    # (first epochs done => compile finished, checkpoints exist)
+    lines0: list = []
+
+    def _reader():
+        for line in p0.stdout:
+            lines0.append(line)
+
+    t = threading.Thread(target=_reader, daemon=True)
+    t.start()
+
+    try:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if len(_digests("".join(lines0))) >= 2:
+                break
+            if p0.poll() is not None:
+                pytest.fail("rank 0 exited before the kill: " + "".join(lines0))
+            time.sleep(0.5)
+        else:
+            pytest.fail("no progress within 300s: " + "".join(lines0))
+
+        t_kill = time.monotonic()
+        os.kill(p1.pid, signal.SIGKILL)
+
+        # bounded-time failure: watchdog timeout + poll granularity + exit,
+        # with CI margin — the point is MINUTES, not forever
+        try:
+            p0.wait(timeout=stall_timeout + 120)
+        except subprocess.TimeoutExpired:
+            pytest.fail(
+                "survivor still alive %.0fs after peer death: undefined-hang "
+                "semantics are back" % (time.monotonic() - t_kill)
+            )
+        detect_s = time.monotonic() - t_kill
+        out0 = "".join(lines0)
+        assert p0.returncode != 0, (
+            "survivor exited 0 despite losing its peer:\n" + out0
+        )
+        # either our watchdog fired (75) or the runtime surfaced the dead
+        # peer as an error — both are defined, bounded-time failures; the
+        # watchdog is the guaranteed backstop
+        assert "CLI_RC 0" not in out0
+    finally:
+        for p in (p0, p1):
+            if p.poll() is None:
+                p.kill()
+        t.join(timeout=10)
+
+    phase_a = _digests("".join(lines0))
+    assert phase_a, "no digests recorded before the failure"
+
+    # --- resume: relaunch BOTH ranks with --load on the shared ckpts ---
+    coord2 = f"127.0.0.1:{_free_port()}"
+    q0 = _spawn_soak(0, coord2, logdir, max_epoch, True, stall_timeout)
+    q1 = _spawn_soak(1, coord2, logdir, max_epoch, True, stall_timeout)
+    outs = []
+    for q in (q0, q1):
+        try:
+            out, _ = q.communicate(timeout=600)
+        finally:
+            if q.poll() is None:
+                q.kill()
+        outs.append(out)
+        assert q.returncode == 0, out
+        assert "CLI_RC 0" in out, out
+    d0, d1 = _digests(outs[0]), _digests(outs[1])
+    assert d0 and d0 == d1, (
+        "resumed ranks diverged:\nrank0 %s\nrank1 %s" % (d0, d1)
+    )
+    # schedule continued, not restarted: resumed leg trains only the
+    # remaining epochs (the soak is 8 epochs total; >=2 ran before the kill)
+    assert len(d0) < max_epoch, (len(d0), d0)
+
+    print(
+        "rank-failure e2e: detect+exit %.1fs after SIGKILL; resume ran %d "
+        "epochs to completion in lockstep" % (detect_s, len(d0))
+    )
