@@ -280,13 +280,22 @@ def read_hyper_file(path: str) -> Dict[str, float]:
         return {}
     try:
         with open(path) as f:
-            return {
-                k.strip(): float(v)
-                for k, v in (line.split(":") for line in f if ":" in line)
-            }
-    except (ValueError, OSError):
-        logger.warn("could not parse %s", path)
+            lines = f.readlines()
+    except OSError:
+        logger.warn("could not read %s", path)
         return {}
+    # parse per line: one typo mid-live-edit must not discard every other
+    # override (silently reverting lr/beta to scheduled values)
+    out: Dict[str, float] = {}
+    for line in lines:
+        if ":" not in line:
+            continue
+        k, _, v = line.partition(":")
+        try:
+            out[k.strip()] = float(v)
+        except ValueError:
+            logger.warn("ignoring malformed line in %s: %r", path, line.strip())
+    return out
 
 
 class HumanHyperParamSetter(HyperParamSetter):
@@ -401,8 +410,9 @@ class StatPrinter(Callback):
 class ModelSaver(Callback):
     """Save the TrainState every epoch (chief only in multi-host)."""
 
-    def __init__(self, ckpt_dir: Optional[str] = None):
+    def __init__(self, ckpt_dir: Optional[str] = None, max_to_keep: int = 3):
         self.ckpt_dir = ckpt_dir
+        self.max_to_keep = max_to_keep
 
     def before_train(self):
         from distributed_ba3c_tpu.train.checkpoint import CheckpointManager
@@ -414,7 +424,9 @@ class ModelSaver(Callback):
         # saves are collective in multi-process runs (chief-only saving
         # deadlocks the chief in orbax's barrier). Metadata/pruning are
         # chief-only inside CheckpointManager.
-        self.trainer.ckpt_manager = CheckpointManager(d)
+        self.trainer.ckpt_manager = CheckpointManager(
+            d, max_to_keep=self.max_to_keep
+        )
 
     def trigger_epoch(self):
         if self.trainer.ckpt_manager is not None:

@@ -30,6 +30,25 @@ class CheckpointManager:
         if os.path.isfile(self._meta_path):
             with open(self._meta_path) as f:
                 self._meta = json.load(f)
+        self._run_meta_path = os.path.join(self.root, "run_meta.json")
+
+    def write_run_meta(self, **fields):
+        """Persist run-shape facts (steps_per_epoch, batch shape, ...) next to
+        the checkpoints so a resume can detect a mismatched schedule: the
+        epoch counter derives from step // steps_per_epoch, so resuming with
+        a different shape silently stretches the LR/beta anneal."""
+        if jax.process_index() != 0:
+            return
+        tmp = self._run_meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(fields, f)
+        os.replace(tmp, self._run_meta_path)
+
+    def read_run_meta(self) -> dict:
+        if os.path.isfile(self._run_meta_path):
+            with open(self._run_meta_path) as f:
+                return json.load(f)
+        return {}
 
     def _write_meta(self):
         tmp = self._meta_path + ".tmp"

@@ -1,0 +1,116 @@
+"""Independent re-evaluation sweep over a run's kept checkpoints.
+
+The north-star verification protocol (VERDICT r3 Missing #1): in-training
+evals are noisy (64-ep reads sit +-0.5 around fresh-seed 128-ep re-evals),
+so the claimed crossing must come from INDEPENDENT re-evals of kept
+checkpoints — fresh seeds, >=128 episodes, a horizon covering full episodes.
+
+Usage:
+    python scripts/eval_sweep.py --env jax:pong \
+        --load runs/ns_r4_a/checkpoints [--steps 40000,44800,...] \
+        --nr_eval 128 --max_steps 10000 --threshold 18 \
+        --out runs/ns_r4_a/eval_sweep.json
+
+Walks every kept step (checkpoint.json "all" list) in ascending order unless
+--steps narrows it, evaluates each with the on-device greedy Evaluator on a
+seed stream DISJOINT from training's (train uses fold_in(1000+epoch); this
+uses fold_in(777000+step)), and writes one JSON with per-step means plus the
+earliest step clearing --threshold. ONE process, one TPU claim: do not run
+while a training run holds the chip (see .claude/skills/verify/SKILL.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+
+from distributed_ba3c_tpu.config import BA3CConfig
+from distributed_ba3c_tpu.envs import jaxenv
+from distributed_ba3c_tpu.fused.loop import make_greedy_eval
+from distributed_ba3c_tpu.models.a3c import BA3CNet
+from distributed_ba3c_tpu.ops.gradproc import make_optimizer
+from distributed_ba3c_tpu.parallel.mesh import make_mesh
+from distributed_ba3c_tpu.parallel.train_step import create_train_state
+from distributed_ba3c_tpu.train.checkpoint import CheckpointManager
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--env", default="jax:pong")
+    ap.add_argument("--load", required=True)
+    ap.add_argument("--steps", default=None,
+                    help="comma-separated step subset (default: all kept)")
+    ap.add_argument("--nr_eval", type=int, default=128)
+    ap.add_argument("--max_steps", type=int, default=10000)
+    ap.add_argument("--threshold", type=float, default=None)
+    ap.add_argument("--fc_units", type=int, default=512)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    env = jaxenv.get_env(args.env.split(":", 1)[1])
+    cfg = BA3CConfig(num_actions=env.num_actions, fc_units=args.fc_units)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    opt = make_optimizer(cfg.learning_rate, cfg.adam_epsilon, cfg.grad_clip_norm)
+    target = jax.device_get(
+        create_train_state(jax.random.PRNGKey(0), model, cfg, opt)
+    )
+
+    mgr = CheckpointManager(args.load)
+    steps = (
+        [int(s) for s in args.steps.split(",")]
+        if args.steps
+        else sorted(mgr._meta.get("all", []))
+    )
+    if not steps:
+        raise SystemExit(f"no checkpoints recorded under {args.load}")
+
+    mesh = make_mesh()
+    evaluate = make_greedy_eval(
+        model, cfg, mesh, env, n_envs=args.nr_eval, max_steps=args.max_steps
+    )
+
+    results = []
+    earliest = None
+    for step in steps:
+        state = mgr.restore(target, step)
+        # integer seed stream provably disjoint from training's 1000+epoch
+        mean, mx, n = evaluate(state.params, 777000 + step)
+        rec = {"step": step, "eval_mean": round(mean, 3),
+               "eval_max": round(mx, 2), "episodes": n}
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+        if (
+            args.threshold is not None
+            and earliest is None
+            and n >= args.nr_eval
+            and mean >= args.threshold
+        ):
+            earliest = rec
+    summary = {
+        "load": args.load,
+        "nr_eval": args.nr_eval,
+        "max_steps": args.max_steps,
+        "threshold": args.threshold,
+        "seed_stream": "777000+step, disjoint from training's 1000+epoch",
+        "results": results,
+        "earliest_at_threshold": earliest,
+    }
+    out = args.out or f"{args.load}/../eval_sweep.json"
+    with open(out, "w") as f:
+        json.dump(summary, f, indent=1)
+    print(f"wrote {out}", flush=True)
+    if args.threshold is not None:
+        print(
+            "earliest independently-verified >= %.4g: %s"
+            % (args.threshold, earliest or "NONE in sweep"),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -226,3 +226,39 @@ def test_checkpoint_max_to_keep_one_never_deletes_latest(tmp_path):
     import os
 
     assert os.path.isdir(tmp_path / "ck" / "ckpt-1")
+
+
+def test_checkpoint_run_meta_roundtrip(tmp_path):
+    """run_meta.json persists the run shape next to the checkpoints so a
+    resume can warn on a schedule-stretching mismatch (ADVICE r3 #2)."""
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    assert mgr.read_run_meta() == {}
+    mgr.write_run_meta(steps_per_epoch=3200, batch_size=640, rollout_len=20)
+    meta = CheckpointManager(str(tmp_path / "ck")).read_run_meta()
+    assert meta == {"steps_per_epoch": 3200, "batch_size": 640,
+                    "rollout_len": 20}
+
+
+def test_checkpoint_keep_all_for_sweeps(tmp_path):
+    """--max_to_keep large retains EVERY saved step (the post-hoc crossing
+    verification protocol, scripts/eval_sweep.py, needs all of them)."""
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=64)
+    for s in range(1, 11):
+        mgr.save({"w": np.full(2, float(s))}, s)
+    assert sorted(mgr._meta["all"]) == list(range(1, 11))
+    import os
+
+    for s in range(1, 11):
+        assert os.path.isdir(tmp_path / "ck" / f"ckpt-{s}")
+
+
+def test_read_hyper_file_keeps_valid_lines_on_typo(tmp_path):
+    """A malformed line mid-live-edit must not discard the other overrides
+    (ADVICE r3 #3: the old whole-file parse reverted lr AND beta on one
+    typo)."""
+    from distributed_ba3c_tpu.train.callbacks import read_hyper_file
+
+    p = tmp_path / "hyper.txt"
+    p.write_text("learning_rate: 0.001\nentropy_beta: oops\ngamma: 0.99\n")
+    out = read_hyper_file(str(p))
+    assert out == {"learning_rate": 0.001, "gamma": 0.99}
