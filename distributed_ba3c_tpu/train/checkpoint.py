@@ -73,7 +73,10 @@ class CheckpointManager:
             wait()
         if jax.process_index() != 0:
             return path
-        self._meta["all"].append(step)
+        if step not in self._meta["all"]:
+            # re-saving an existing step (a killed run re-driven over the
+            # same logdir) must not duplicate the bookkeeping entry
+            self._meta["all"].append(step)
         self._meta["latest"] = step
         # prune oldest beyond max_to_keep; NEVER delete the best or the
         # just-saved latest (with max_to_keep=1 the old loop could delete the
@@ -103,6 +106,13 @@ class CheckpointManager:
                 self._write_meta()
             return True
         return False
+
+    @property
+    def all_steps(self) -> list:
+        """Every kept step, ascending, deduplicated (the eval-sweep
+        enumeration surface; metadata written before the dedup-on-save fix
+        may carry repeats)."""
+        return sorted(set(self._meta.get("all", [])))
 
     @property
     def latest_step(self) -> Optional[int]:

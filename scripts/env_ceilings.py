@@ -7,10 +7,13 @@ by playing each env with a STATE-AWARE oracle policy (direct access to the
 env's NamedTuple state — strictly more information than any pixel policy),
 plus closed-form arithmetic where the mechanics make it exact.
 
-Run on CPU (serialize around TPU runs — see .claude/skills/verify/SKILL.md):
-    JAX_PLATFORMS=cpu python scripts/env_ceilings.py [--episodes 128]
+Run on CPU with the axon-free PYTHONPATH (safe concurrently with TPU runs —
+see the safe-CPU-bypass note in .claude/skills/verify/SKILL.md):
+    env PYTHONPATH=/root/repo JAX_PLATFORMS=cpu \
+        python scripts/env_ceilings.py [--episodes 128]
 
-Prints one JSON line per env and writes runs/env_ceilings.json.
+Prints one JSON line per env and writes runs/env_ceilings.json (path
+resolved against the repo root, any cwd).
 """
 
 from __future__ import annotations
@@ -278,9 +281,15 @@ def main():
         r = fn(args.episodes)
         results.append(r)
         print(json.dumps(r), flush=True)
-    with open(args.out, "w") as f:
+    out = args.out
+    if not os.path.isabs(out):
+        # anchor to the repo root so all the simulated episodes are never
+        # lost to a cwd-relative FileNotFoundError at the very end
+        out = os.path.join(os.path.dirname(os.path.dirname(__file__)), out)
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
         json.dump(results, f, indent=1)
-    print(f"wrote {args.out}")
+    print(f"wrote {out}")
 
 
 if __name__ == "__main__":

@@ -17,16 +17,7 @@ import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-import jax
-
-from distributed_ba3c_tpu.config import BA3CConfig
-from distributed_ba3c_tpu.envs import jaxenv
-from distributed_ba3c_tpu.fused.loop import make_greedy_eval
-from distributed_ba3c_tpu.models.a3c import BA3CNet
-from distributed_ba3c_tpu.ops.gradproc import make_optimizer
-from distributed_ba3c_tpu.parallel.mesh import make_mesh
-from distributed_ba3c_tpu.parallel.train_step import create_train_state
-from distributed_ba3c_tpu.train.checkpoint import CheckpointManager
+from distributed_ba3c_tpu.train.eval_tools import make_checkpoint_evaluator
 
 
 def main():
@@ -40,13 +31,9 @@ def main():
     ap.add_argument("--fc_units", type=int, default=512)
     args = ap.parse_args()
 
-    env = jaxenv.get_env(args.env.split(":", 1)[1])
-    cfg = BA3CConfig(num_actions=env.num_actions, fc_units=args.fc_units)
-    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
-    opt = make_optimizer(cfg.learning_rate, cfg.adam_epsilon, cfg.grad_clip_norm)
-    target = create_train_state(jax.random.PRNGKey(0), model, cfg, opt)
-
-    mgr = CheckpointManager(args.load)
+    mgr, target, evaluate, _ = make_checkpoint_evaluator(
+        args.env, args.load, args.nr_eval, args.max_steps, args.fc_units
+    )
     step = args.step
     if args.best and step is None:
         step = mgr.best_step
@@ -55,14 +42,8 @@ def main():
                 "--best: no best-marked checkpoint in this run "
                 "(eval never improved); pass --step or drop --best"
             )
-    state = mgr.restore(jax.device_get(target), step)
+    state = mgr.restore(target, step)
 
-    mesh = make_mesh()
-    n_data = mesh.shape["data"]
-    n_eval = max(n_data, (args.nr_eval + n_data - 1) // n_data * n_data)
-    evaluate = make_greedy_eval(
-        model, cfg, mesh, env, n_eval, max_steps=args.max_steps
-    )
     mean, mx, n = evaluate(state.params, 123)
     print(
         json.dumps(

@@ -1,0 +1,117 @@
+"""launch_multihost.sh relaunch contract, tested with a stubbed train.py.
+
+The script's exit-75 loop is the recovery half of the rank-failure
+semantics (parallel/watchdog.py): a rank that loses lockstep exits 75 and
+must be relaunched WITH --load on the run's checkpoint dir — while a fresh
+first launch over a reused logdir must NOT silently resume. jax-free:
+the stub train.py records its argv and scripts its own exit codes.
+"""
+
+import json
+import os
+import stat
+import subprocess
+
+_SCRIPT = os.path.join(
+    os.path.dirname(os.path.dirname(__file__)), "scripts", "launch_multihost.sh"
+)
+
+_STUB = r"""#!/usr/bin/env python3
+import json, os, sys
+calls_path = os.environ["STUB_CALLS"]
+calls = json.load(open(calls_path)) if os.path.exists(calls_path) else []
+calls.append(sys.argv[1:])
+json.dump(calls, open(calls_path, "w"))
+codes = json.loads(os.environ["STUB_EXIT_CODES"])
+sys.exit(codes[len(calls) - 1])
+"""
+
+
+def _run(tmp_path, exit_codes, extra_args, with_ckpt_dir):
+    """Run the launcher with a stub train.py; return (rc, recorded argvs)."""
+    workdir = tmp_path / "wd"
+    workdir.mkdir(exist_ok=True)
+    stub = workdir / "train.py"
+    stub.write_text(_STUB)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    logdir = workdir / "logs"
+    logdir.mkdir(exist_ok=True)
+    if with_ckpt_dir:
+        (logdir / "checkpoints").mkdir(exist_ok=True)
+    calls = workdir / "calls.json"
+    env = dict(os.environ)
+    env["STUB_CALLS"] = str(calls)
+    env["STUB_EXIT_CODES"] = json.dumps(exit_codes)
+    env["SLURM_PROCID"] = "0"  # skip the hostname->rank lookup
+    p = subprocess.run(
+        ["bash", _SCRIPT, "h1:9900,h2:9900", "--logdir", str(logdir)]
+        + extra_args,
+        cwd=workdir,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    recorded = json.load(open(calls)) if calls.exists() else []
+    return p.returncode, recorded, p.stderr
+
+
+def test_exit75_relaunches_with_load(tmp_path):
+    rc, calls, err = _run(
+        tmp_path, [75, 0], extra_args=[], with_ckpt_dir=True
+    )
+    assert rc == 0, err
+    assert len(calls) == 2
+    # first launch: NO --load even though a checkpoint dir exists (fresh
+    # first launches stay fresh — silent auto-resume could 'complete' a
+    # finished run with zero training)
+    assert "--load" not in calls[0]
+    # relaunch after exit 75: resumes from the logdir's checkpoints
+    assert "--load" in calls[1]
+    load_path = calls[1][calls[1].index("--load") + 1]
+    assert load_path.endswith("checkpoints")
+    # worker identity args survive both launches
+    for c in calls:
+        assert "--worker_hosts" in c and "--task_index" in c
+
+
+def test_equals_form_logdir_is_parsed(tmp_path):
+    """--logdir=PATH (argparse's '=' form) must be recognized too — a missed
+    parse relaunches WITHOUT --load and restarts training from step 0."""
+    workdir = tmp_path / "wd"
+    workdir.mkdir()
+    logdir = workdir / "logs"
+    (logdir / "checkpoints").mkdir(parents=True)
+    stub = workdir / "train.py"
+    stub.write_text(_STUB)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    calls = workdir / "calls.json"
+    env = dict(os.environ)
+    env["STUB_CALLS"] = str(calls)
+    env["STUB_EXIT_CODES"] = json.dumps([75, 0])
+    env["SLURM_PROCID"] = "0"
+    p = subprocess.run(
+        ["bash", _SCRIPT, "h1:9900,h2:9900", f"--logdir={logdir}"],
+        cwd=workdir, env=env, capture_output=True, text=True, timeout=60,
+    )
+    assert p.returncode == 0, p.stderr
+    recorded = json.load(open(calls))
+    assert "--load" in recorded[1]
+
+
+def test_caller_passed_load_is_not_duplicated(tmp_path):
+    rc, calls, err = _run(
+        tmp_path, [75, 0], extra_args=["--load", "/some/ckpts"],
+        with_ckpt_dir=True,
+    )
+    assert rc == 0, err
+    # the script must not append a second --load overriding the caller's
+    for c in calls:
+        assert c.count("--load") == 1
+        assert c[c.index("--load") + 1] == "/some/ckpts"
+
+
+def test_nonzero_non75_exit_propagates(tmp_path):
+    rc, calls, err = _run(tmp_path, [1], extra_args=[], with_ckpt_dir=True)
+    assert rc == 1
+    assert len(calls) == 1
