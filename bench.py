@@ -236,7 +236,19 @@ def main():
         "zmq-null = same plane with a no-device null predictor (the "
         "serialization+transport+batching ceiling, PERF.md)",
     )
+    ap.add_argument(
+        "--tpu_lock",
+        default="wait",
+        choices=["wait", "fail", "off"],
+        help="host-local TPU-claim mutex (utils/devicelock.py). Default "
+        "wait: a bench launched while training holds the chip QUEUES "
+        "instead of wedging the pool (the round-4 outage class).",
+    )
     args = ap.parse_args()
+
+    from distributed_ba3c_tpu.utils.devicelock import guard_tpu
+
+    _lock = guard_tpu("bench.py", mode=args.tpu_lock)  # noqa: F841 — held for process lifetime
     if args.plane == "zmq":
         print(json.dumps(bench_zmq_plane()))
     elif args.plane == "zmq-null":

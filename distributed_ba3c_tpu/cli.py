@@ -88,6 +88,7 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--steps_per_dispatch", type=int, default=1, help="fused trainer: wrap K update steps in one lax.scan program (one host dispatch per K updates; must divide --steps_per_epoch). Removes per-step dispatch overhead without relying on host pipelining")
     p.add_argument("--rank_stall_timeout", type=float, default=0, help="multi-host: seconds without epoch progress before a rank declares a peer dead and exits 75 (0 = default 600s when multi-host; must exceed the slowest epoch incl. first compile). Relaunch with --load to resume")
     p.add_argument("--seed", type=int, default=0, help="fused trainer: PRNG seed for params/envs/action sampling (whole-trajectory determinism per seed; multi-seed runs disclose seed selection in RESULTS.md)")
+    p.add_argument("--tpu_lock", default="wait", choices=["wait", "fail", "off"], help="host-local TPU-claim mutex (utils/devicelock.py): wait = queue behind the current holder, fail = exit with the holder's pid/run, off = no guard. CPU-platform runs never take the lock")
     return p
 
 
@@ -200,6 +201,30 @@ def main(argv: Optional[list] = None) -> int:
             "gradients ride a psum over ICI (no parameter servers). Exiting."
         )
         return 0
+
+    # Pure-argparse validation BEFORE the lock: in wait mode a misconfigured
+    # run would otherwise queue for hours behind the holder only to fail on
+    # a check that needs no device (jax-touching validation stays below —
+    # env-module imports may init the backend, which must not precede the
+    # lock).
+    if args.env.startswith("zmq:") and not (args.pipe_c2s and args.pipe_s2c):
+        raise SystemExit(
+            "--env zmq: means external env-server fleets feed this "
+            "learner — give them reachable endpoints via --pipe_c2s/"
+            "--pipe_s2c (e.g. tcp://0.0.0.0:5555 / tcp://0.0.0.0:5556)"
+        )
+    if args.steps_per_dispatch > 1 and args.steps_per_epoch % args.steps_per_dispatch:
+        raise SystemExit(
+            f"--steps_per_dispatch {args.steps_per_dispatch} must divide "
+            f"--steps_per_epoch {args.steps_per_epoch}"
+        )
+
+    # Take the host-local TPU claim BEFORE the first jax backend touch: two
+    # concurrent claimants don't error, they wedge the exclusive pool
+    # (OPERATIONS.md; utils/devicelock.py). No-op on the CPU platform.
+    from distributed_ba3c_tpu.utils.devicelock import guard_tpu
+
+    _tpu_lock = guard_tpu(args.logdir, mode=args.tpu_lock)  # noqa: F841 — held for process lifetime
 
     import jax
 

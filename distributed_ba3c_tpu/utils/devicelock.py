@@ -1,0 +1,206 @@
+"""Local TPU-claim mutex: one device-acquiring process per host at a time.
+
+Why this exists: the TPU pool behind this rig's tunnel is EXCLUSIVE — two
+local processes initializing the backend concurrently don't error, they
+wedge the pool itself (docs/OPERATIONS.md "the chip is exclusive"; the
+round-4 bench-vs-training double-claim cost a full day of the only chip).
+The reference had no equivalent problem — its 64-node CPU cluster had no
+single scarce accelerator (SURVEY.md §3's `src/train.py` workers each owned
+their own host) — so this guard is TPU-rig-specific failure detection in
+the same spirit as ``parallel/watchdog.py``: turn an undefined wedge into a
+defined, observable outcome (queue or refuse, never double-claim).
+
+Mechanics: ``flock(2)`` on a well-known path. The kernel releases the lock
+when the holder dies — any exit path, including SIGKILL — so there is no
+stale-lock protocol; the holder JSON written into the file (pid / run name /
+since) is advisory context for log messages only, never trusted for
+liveness. Processes on the safe CPU bypass (``JAX_PLATFORMS=cpu``) never
+touch the pool claim and therefore skip the lock entirely, so CPU test
+suites and tooling coexist with a live TPU run.
+
+Modes (CLI ``--tpu_lock``, default ``wait``):
+  - ``wait``: block until the chip frees, logging the holder once a minute.
+    A queued bench behind a finishing training run is the correct outcome;
+    the round-4 alternative was a wedged pool.
+  - ``fail``: exit immediately with the holder's pid/run in the message —
+    for interactive use where queueing would surprise.
+  - ``off``: escape hatch (multi-process single-host experiments that
+    intentionally share a mesh, e.g. the CPU-mesh multihost soaks).
+"""
+
+from __future__ import annotations
+
+import errno
+import fcntl
+import json
+import os
+import time
+from typing import Callable, Optional
+
+LOCK_PATH_ENV = "BA3C_TPU_LOCK"
+DEFAULT_LOCK_PATH = "/tmp/ba3c_tpu.lock"
+MODES = ("wait", "fail", "off")
+
+
+def lock_path() -> str:
+    return os.environ.get(LOCK_PATH_ENV) or DEFAULT_LOCK_PATH
+
+
+def tpu_lock_needed() -> bool:
+    """False when this process runs on the CPU platform (never claims the
+    pool). Any other platform setting — including unset, which lets the
+    container's sitecustomize pick the TPU — needs the lock.
+
+    When this returns False, ``guard_tpu`` also FORCES jax onto the CPU
+    platform: the container's sitecustomize re-registers the TPU plugin and
+    overrides the env var (cli.py's long-standing compensation), so trusting
+    the env var alone would skip the lock while still claiming the chip —
+    the exact double-claim the lock exists to prevent."""
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if plat and all(p.strip() == "cpu" for p in plat.split(",") if p.strip()):
+        return False
+    return True
+
+
+def _force_cpu_platform() -> None:
+    """Make the no-lock skip safe: pin jax to CPU so a sitecustomize that
+    overrides JAX_PLATFORMS cannot route this (unlocked) process to the
+    TPU. Importing jax is claim-free; only backend init claims."""
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # no jax in this interpreter -> nothing can claim a device
+
+
+class TpuLockHeld(SystemExit):
+    """Raised in ``fail`` mode; SystemExit so entry points exit non-zero
+    with the message and no traceback."""
+
+
+class TpuLock:
+    """Holds the host-local TPU claim for this process's lifetime.
+
+    The fd stays open until ``release()`` or process death; flock identity
+    is the open file description, so children sharing the fd after fork
+    would also share the lock — entry points acquire before spawning
+    workers, which is the intended containment.
+    """
+
+    def __init__(self, run_name: str, path: Optional[str] = None):
+        self.run_name = run_name
+        self.path = path or lock_path()
+        self._fd: Optional[int] = None
+
+    @property
+    def held(self) -> bool:
+        return self._fd is not None
+
+    def _read_holder(self) -> str:
+        try:
+            with open(self.path, "r") as f:
+                info = json.load(f)
+            return "pid %s (run %r, since %s)" % (
+                info.get("pid"), info.get("run"), info.get("since"),
+            )
+        except Exception:
+            return "unknown holder"
+
+    def _try_once(self) -> bool:
+        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o666)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError as e:
+            os.close(fd)
+            if e.errno in (errno.EAGAIN, errno.EACCES):
+                return False
+            raise
+        # Holder info is advisory (for the *other* process's log message);
+        # liveness is the flock itself.
+        os.ftruncate(fd, 0)
+        os.write(fd, json.dumps({
+            "pid": os.getpid(),
+            "run": self.run_name,
+            "since": time.strftime("%Y-%m-%d %H:%M:%S"),
+        }).encode())
+        os.fsync(fd)
+        self._fd = fd
+        return True
+
+    def acquire(
+        self,
+        mode: str = "wait",
+        poll_s: float = 5.0,
+        timeout_s: Optional[float] = None,
+        log: Callable[[str], None] = print,
+    ) -> "TpuLock":
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        if mode == "off" or self._try_once():
+            return self
+        holder = self._read_holder()
+        if mode == "fail":
+            raise TpuLockHeld(
+                f"[tpu-lock] the TPU is held by {holder} ({self.path}). "
+                "Two local claimants wedge the pool (OPERATIONS.md); rerun "
+                "with --tpu_lock wait to queue, or stop the holder."
+            )
+        t0 = time.monotonic()
+        last_log = 0.0
+        log(f"[tpu-lock] waiting for TPU held by {holder} ({self.path})")
+        while not self._try_once():
+            waited = time.monotonic() - t0
+            if timeout_s is not None and waited >= timeout_s:
+                raise TpuLockHeld(
+                    f"[tpu-lock] gave up after {waited:.0f}s; TPU still "
+                    f"held by {self._read_holder()} ({self.path})"
+                )
+            if waited - last_log >= 60.0:
+                last_log = waited
+                log(
+                    f"[tpu-lock] still waiting ({waited:.0f}s) — holder: "
+                    f"{self._read_holder()}"
+                )
+            time.sleep(poll_s)
+        log(f"[tpu-lock] acquired after {time.monotonic() - t0:.0f}s")
+        return self
+
+    def release(self) -> None:
+        if self._fd is not None:
+            try:
+                # Clear advisory holder info so a later reader doesn't see
+                # our stale pid next to an unlocked file.
+                os.ftruncate(self._fd, 0)
+            except OSError:
+                pass
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+            self._fd = None
+
+    def __enter__(self) -> "TpuLock":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def guard_tpu(
+    run_name: str,
+    mode: str = "wait",
+    poll_s: float = 5.0,
+    timeout_s: Optional[float] = None,
+    log: Callable[[str], None] = print,
+) -> Optional[TpuLock]:
+    """Entry-point helper: acquire the host-local TPU claim unless this
+    process is on the CPU platform (or mode='off'). Call BEFORE the first
+    jax backend touch; hold for process lifetime (the kernel releases on
+    death). Returns the held lock, or None when no lock is needed."""
+    if mode == "off":
+        return None
+    if not tpu_lock_needed():
+        _force_cpu_platform()
+        return None
+    return TpuLock(run_name).acquire(
+        mode=mode, poll_s=poll_s, timeout_s=timeout_s, log=log
+    )

@@ -275,7 +275,13 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--episodes", type=int, default=128)
     ap.add_argument("--out", default="runs/env_ceilings.json")
+    ap.add_argument("--tpu_lock", default="wait", choices=["wait", "fail", "off"])
     args = ap.parse_args()
+
+    from distributed_ba3c_tpu.utils.devicelock import guard_tpu
+
+    _lock = guard_tpu("env_ceilings", mode=args.tpu_lock)  # noqa: F841
+
     results = []
     for fn in (boxing_oracle, seaquest_oracle, qbert_oracle):
         r = fn(args.episodes)

@@ -29,7 +29,12 @@ def main():
     ap.add_argument("--nr_eval", type=int, default=32)
     ap.add_argument("--max_steps", type=int, default=20000)
     ap.add_argument("--fc_units", type=int, default=512)
+    ap.add_argument("--tpu_lock", default="wait", choices=["wait", "fail", "off"])
     args = ap.parse_args()
+
+    from distributed_ba3c_tpu.utils.devicelock import guard_tpu
+
+    _lock = guard_tpu("eval_fused", mode=args.tpu_lock)  # noqa: F841
 
     mgr, target, evaluate, _ = make_checkpoint_evaluator(
         args.env, args.load, args.nr_eval, args.max_steps, args.fc_units

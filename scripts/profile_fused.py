@@ -323,7 +323,13 @@ def main():
         default=None,
         help="comma list of grad_chunk_samples: time the FULL step only",
     )
+    ap.add_argument("--tpu_lock", default="wait", choices=["wait", "fail", "off"])
     args = ap.parse_args()
+
+    from distributed_ba3c_tpu.utils.devicelock import guard_tpu
+
+    _lock = guard_tpu("profile_fused", mode=args.tpu_lock)  # noqa: F841
+
     print("devices:", jax.devices(), flush=True)
     shapes = [tuple(map(int, s.split("x"))) for s in args.shapes.split(",")]
     if args.attribute:

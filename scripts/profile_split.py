@@ -29,6 +29,16 @@ T = 20
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tpu_lock", default="wait", choices=["wait", "fail", "off"])
+    args = ap.parse_args()
+
+    from distributed_ba3c_tpu.utils.devicelock import guard_tpu
+
+    _lock = guard_tpu("profile_split", mode=args.tpu_lock)  # noqa: F841
+
     cfg = BA3CConfig(num_actions=pong.num_actions)
     model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
     from distributed_ba3c_tpu.ops.gradproc import make_optimizer

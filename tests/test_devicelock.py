@@ -1,0 +1,123 @@
+"""TPU-claim mutex contract (utils/devicelock.py) — jax-free.
+
+The guard exists because two local device claimants wedge the exclusive
+pool rather than erroring (OPERATIONS.md; the round-4 outage). Contract:
+exclusion across processes, fail mode reports the holder, wait mode queues,
+and a SIGKILLed holder releases the lock via the kernel (no stale-lock
+protocol to get wrong).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from distributed_ba3c_tpu.utils.devicelock import (
+    TpuLock,
+    TpuLockHeld,
+    guard_tpu,
+    tpu_lock_needed,
+)
+
+_HOLDER = r"""
+import sys, time
+from distributed_ba3c_tpu.utils.devicelock import TpuLock
+lock = TpuLock("holder-run", path=sys.argv[1])
+lock.acquire(mode="fail")
+print("HELD", flush=True)
+time.sleep(120)
+"""
+
+
+def _spawn_holder(path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    p = subprocess.Popen(
+        [sys.executable, "-c", _HOLDER, str(path)],
+        stdout=subprocess.PIPE, env=env, text=True,
+    )
+    assert p.stdout.readline().strip() == "HELD"
+    return p
+
+
+def test_needed_skips_cpu_platform(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert not tpu_lock_needed()
+    assert guard_tpu("x") is None
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    assert tpu_lock_needed()
+    monkeypatch.delenv("JAX_PLATFORMS")
+    # unset lets the sitecustomize pick the TPU -> must lock
+    assert tpu_lock_needed()
+
+
+def test_fail_mode_reports_holder(tmp_path):
+    path = tmp_path / "tpu.lock"
+    holder = _spawn_holder(path)
+    try:
+        with pytest.raises(TpuLockHeld) as exc:
+            TpuLock("second", path=str(path)).acquire(mode="fail")
+        msg = str(exc.value)
+        assert str(holder.pid) in msg
+        assert "holder-run" in msg
+    finally:
+        holder.kill()
+        holder.wait()
+
+
+def test_wait_mode_queues_until_release(tmp_path):
+    path = tmp_path / "tpu.lock"
+    first = TpuLock("first", path=str(path)).acquire(mode="fail")
+    threading.Timer(0.5, first.release).start()
+    t0 = time.monotonic()
+    second = TpuLock("second", path=str(path)).acquire(
+        mode="wait", poll_s=0.05, log=lambda _m: None
+    )
+    assert second.held
+    assert time.monotonic() - t0 >= 0.4
+    second.release()
+
+
+def test_wait_mode_timeout(tmp_path):
+    path = tmp_path / "tpu.lock"
+    with TpuLock("first", path=str(path)).acquire(mode="fail"):
+        with pytest.raises(TpuLockHeld, match="gave up"):
+            TpuLock("second", path=str(path)).acquire(
+                mode="wait", poll_s=0.05, timeout_s=0.3, log=lambda _m: None
+            )
+
+
+def test_sigkilled_holder_releases(tmp_path):
+    """The whole point of flock over a pidfile: ANY death path frees the
+    chip claim — no stale lock after a SIGKILLed training run."""
+    path = tmp_path / "tpu.lock"
+    holder = _spawn_holder(path)
+    os.kill(holder.pid, signal.SIGKILL)
+    holder.wait()
+    lock = TpuLock("after", path=str(path)).acquire(
+        mode="wait", poll_s=0.05, timeout_s=5.0, log=lambda _m: None
+    )
+    assert lock.held
+    lock.release()
+
+
+def test_holder_info_written_and_cleared(tmp_path):
+    path = tmp_path / "tpu.lock"
+    lock = TpuLock("myrun", path=str(path)).acquire(mode="fail")
+    info = json.load(open(path))
+    assert info["pid"] == os.getpid()
+    assert info["run"] == "myrun"
+    lock.release()
+    assert open(path).read() == ""
+
+
+def test_off_mode_never_locks(tmp_path):
+    path = tmp_path / "tpu.lock"
+    with TpuLock("a", path=str(path)).acquire(mode="fail"):
+        # off mode must not block even while another process holds it
+        assert not TpuLock("b", path=str(path)).acquire(mode="off").held
