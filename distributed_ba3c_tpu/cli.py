@@ -86,7 +86,7 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--pipe_s2c", default=None, help="master action-plane bind address, e.g. tcp://0.0.0.0:5556 (default: per-pid ipc://)")
     p.add_argument("--max_to_keep", type=int, default=3, help="checkpoints retained (besides best); raise to keep every eval-epoch checkpoint for post-hoc crossing verification")
     p.add_argument("--steps_per_dispatch", type=int, default=1, help="fused trainer: wrap K update steps in one lax.scan program (one host dispatch per K updates; must divide --steps_per_epoch). Removes per-step dispatch overhead without relying on host pipelining")
-    p.add_argument("--rank_stall_timeout", type=float, default=0, help="multi-host: seconds without epoch progress before a rank declares a peer dead and exits 75 (0 = default 600s when multi-host; must exceed the slowest epoch incl. first compile). Relaunch with --load to resume")
+    p.add_argument("--rank_stall_timeout", type=float, default=0, help="multi-host: seconds without proven progress (beats land after the dispatch-window metrics fetch, after eval, and after the collective save) before a rank declares a peer dead and exits 75 (0 = default 600s when multi-host; -1 disables the watchdog; the limit self-raises to 2x the slowest healthy window). Relaunch with --load to resume")
     p.add_argument("--seed", type=int, default=0, help="fused trainer: PRNG seed for params/envs/action sampling (whole-trajectory determinism per seed; multi-seed runs disclose seed selection in RESULTS.md)")
     p.add_argument("--tpu_lock", default="wait", choices=["wait", "fail", "off"], help="host-local TPU-claim mutex (utils/devicelock.py): wait = queue behind the current holder, fail = exit with the holder's pid/run, off = no guard. CPU-platform runs never take the lock")
     return p
@@ -207,7 +207,11 @@ def main(argv: Optional[list] = None) -> int:
     # a check that needs no device (jax-touching validation stays below —
     # env-module imports may init the backend, which must not precede the
     # lock).
-    if args.env.startswith("zmq:") and not (args.pipe_c2s and args.pipe_s2c):
+    if (
+        args.task == "train"
+        and args.env.startswith("zmq:")
+        and not (args.pipe_c2s and args.pipe_s2c)
+    ):
         raise SystemExit(
             "--env zmq: means external env-server fleets feed this "
             "learner — give them reachable endpoints via --pipe_c2s/"
@@ -326,12 +330,7 @@ def main(argv: Optional[list] = None) -> int:
     # wire-compatible speaker) connect to this learner's tcp:// pipes.
     external_fleet = args.env.startswith("zmq:")
     if external_fleet:
-        if not (args.pipe_c2s and args.pipe_s2c):
-            raise SystemExit(
-                "--env zmq: means external env-server fleets feed this "
-                "learner — give them reachable endpoints via --pipe_c2s/"
-                "--pipe_s2c (e.g. tcp://0.0.0.0:5555 / tcp://0.0.0.0:5556)"
-            )
+        # endpoint presence was validated pre-lock at the top of main()
         build_player = None
     else:
         build_player = _build_player_factory(args, cfg)

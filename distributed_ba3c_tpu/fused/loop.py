@@ -48,6 +48,13 @@ from distributed_ba3c_tpu.ops.returns import n_step_returns
 from distributed_ba3c_tpu.parallel.mesh import DATA_AXIS
 from distributed_ba3c_tpu.parallel.train_step import TrainState
 
+#: metrics that accumulate IN STATE across an epoch (reset by the outer
+#: loop): the K-step scan reduction takes their LAST value, every other
+#: metric is mean-averaged over the dispatch window. local_step asserts
+#: each of these is in its metrics dict so the two sites cannot
+#: desynchronize (ADVICE r4 #3).
+CUMULATIVE_METRICS = ("episodes", "episode_return_sum")
+
 
 class FusedState(struct.PyTreeNode):
     train: TrainState
@@ -272,8 +279,12 @@ def make_fused_step(
             "reward_per_step": jnp.mean(rewards_t),
         }
         metrics = {k: jax.lax.pmean(v, DATA_AXIS) for k, v in metrics.items()}
+        # cumulative-in-state metrics MUST be listed in CUMULATIVE_METRICS:
+        # that's what tells the K>1 scan reduction to take the last value
+        # instead of the window mean (ADVICE r4 #3)
         metrics["episodes"] = jax.lax.psum(jnp.sum(ep_cnt), DATA_AXIS)
         metrics["episode_return_sum"] = jax.lax.psum(jnp.sum(ep_sum), DATA_AXIS)
+        assert set(CUMULATIVE_METRICS) <= set(metrics)
         return new_state, metrics
 
     def multi_step(state: FusedState, entropy_beta, learning_rate):
@@ -284,12 +295,11 @@ def make_fused_step(
             return local_step(s, entropy_beta, learning_rate)
 
         state, ms = jax.lax.scan(body, state, None, length=steps_per_dispatch)
-        # episode counters are cumulative-in-state (reset once per epoch by
-        # the outer loop), so the LAST step's psum is "episodes so far";
-        # loss-like metrics average over the dispatch window
-        last = ("episodes", "episode_return_sum")
+        # cumulative-in-state metrics (reset once per epoch by the outer
+        # loop): the LAST step's psum is "so far"; loss-like metrics
+        # average over the dispatch window
         metrics = {
-            k: (v[-1] if k in last else jnp.mean(v, axis=0))
+            k: (v[-1] if k in CUMULATIVE_METRICS else jnp.mean(v, axis=0))
             for k, v in ms.items()
         }
         return state, metrics
@@ -659,6 +669,7 @@ def _fused_epoch_body(
     from distributed_ba3c_tpu.utils import logger
 
     best = -np.inf
+    first_eval_done = False
     for epoch in range(epoch0 + 1, args.max_epoch + 1):
         beta = sched(cfg.entropy_beta, args.entropy_beta_final, epoch, beta_mode)
         lr = sched(cfg.learning_rate, args.learning_rate_final, epoch, lr_mode)
@@ -668,6 +679,10 @@ def _fused_epoch_body(
         for _ in range(args.steps_per_epoch // step.steps_per_dispatch):
             state, metrics = step(state, beta, lr)
         metrics = {k: float(v) for k, v in metrics.items()}
+        # the fetch above forced every dispatch's collectives to completion:
+        # proven progress — don't charge the upcoming eval/save to the
+        # compute window's stall budget
+        watchdog.beat()
         dt = time.time() - t0
         fps = args.steps_per_epoch * samples_per_iter / dt
         mean_ret = (
@@ -694,6 +709,12 @@ def _fused_epoch_body(
         # greedy eval — the number the north-star (Pong >= 18) is defined on
         eval_mean = float("nan")
         if epoch % max(args.eval_every, 1) == 0:
+            if not first_eval_done:
+                # the first eval window includes the eval program's XLA
+                # compile — give it the same grace as the first train
+                # compile or a tightly-sized timeout 75-loops right here
+                watchdog.grace()
+                first_eval_done = True
             eval_mean, eval_max, eval_n = evaluate(
                 state.train.params, 1000 + epoch
             )
@@ -704,6 +725,9 @@ def _fused_epoch_body(
                 # no episode finished inside the eval horizon (long rallies):
                 # 0/1 would masquerade as a real score — report nothing
                 eval_mean = float("nan")
+            # eval done: a slow 128-episode eval must not eat into the
+            # save window's stall budget
+            watchdog.beat()
         holder.add_stat("epoch", epoch)
         holder.add_stat("global_step", int(state.train.step))
         holder.add_stat("fps", fps)

@@ -47,12 +47,42 @@ while :; do
   args=("$@")
   # resume ONLY on relaunch after a lost-lockstep exit: the first launch
   # keeps fresh-start semantics even over a reused logdir (a silent
-  # auto-resume there could "complete" a finished run with zero training)
-  if [[ $relaunch -eq 1 && $CALLER_LOADS -eq 0 ]]; then
-    if [[ -n "$LOGDIR" && -d "$LOGDIR/checkpoints" ]]; then
+  # auto-resume there could "complete" a finished run with zero training).
+  # On relaunch the run's OWN checkpoints take precedence over a
+  # caller-supplied --load: the caller's path is a warm-START source, and
+  # reusing it verbatim would discard every checkpoint saved since launch
+  # (recurring rank failures would replay the same training span forever).
+  if [[ $relaunch -eq 1 ]]; then
+    # an ACTUAL saved checkpoint, not just the dir: CheckpointManager
+    # creates $LOGDIR/checkpoints at startup before any save, so a rank
+    # failure during the first epoch leaves an empty dir — resuming from
+    # it would crash (and discard a caller warm start)
+    have_run_ckpt=0
+    if [[ -n "$LOGDIR" ]] && compgen -G "$LOGDIR/checkpoints/ckpt-*" > /dev/null; then
+      have_run_ckpt=1
+    fi
+    if [[ $have_run_ckpt -eq 1 ]]; then
+      if [[ $CALLER_LOADS -eq 1 ]]; then
+        echo "[launch] resume: replacing caller --load with the run's own" \
+          "$LOGDIR/checkpoints (progress since launch lives there)" >&2
+        stripped=()
+        skip_next=0
+        for a in "${args[@]}"; do
+          if [[ $skip_next -eq 1 ]]; then skip_next=0; continue; fi
+          case "$a" in
+            --load) skip_next=1; continue ;;
+            --load=*) continue ;;
+          esac
+          stripped+=("$a")
+        done
+        args=("${stripped[@]}")
+      fi
       args+=(--load "$LOGDIR/checkpoints")
+    elif [[ $CALLER_LOADS -eq 1 ]]; then
+      echo "[launch] exit 75, no run-local checkpoint saved yet — retrying" \
+        "with the caller's --load (warm start)" >&2
     else
-      echo "[launch] exit 75 but no checkpoint dir to resume from" \
+      echo "[launch] exit 75 but no saved checkpoint to resume from" \
         "(logdir='$LOGDIR') — relaunching fresh" >&2
     fi
   fi

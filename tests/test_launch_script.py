@@ -27,8 +27,13 @@ sys.exit(codes[len(calls) - 1])
 """
 
 
-def _run(tmp_path, exit_codes, extra_args, with_ckpt_dir):
-    """Run the launcher with a stub train.py; return (rc, recorded argvs)."""
+def _run(tmp_path, exit_codes, extra_args, with_ckpt_dir, ckpt_saved=True):
+    """Run the launcher with a stub train.py; return (rc, recorded argvs).
+
+    ``with_ckpt_dir`` creates $LOGDIR/checkpoints; ``ckpt_saved`` puts an
+    actual ckpt-* entry inside it (CheckpointManager creates the DIR at
+    startup before any save, so dir-exists alone must not trigger resume).
+    """
     workdir = tmp_path / "wd"
     workdir.mkdir(exist_ok=True)
     stub = workdir / "train.py"
@@ -38,6 +43,8 @@ def _run(tmp_path, exit_codes, extra_args, with_ckpt_dir):
     logdir.mkdir(exist_ok=True)
     if with_ckpt_dir:
         (logdir / "checkpoints").mkdir(exist_ok=True)
+        if ckpt_saved:
+            (logdir / "checkpoints" / "ckpt-80").mkdir(exist_ok=True)
     calls = workdir / "calls.json"
     env = dict(os.environ)
     env["STUB_CALLS"] = str(calls)
@@ -81,7 +88,7 @@ def test_equals_form_logdir_is_parsed(tmp_path):
     workdir = tmp_path / "wd"
     workdir.mkdir()
     logdir = workdir / "logs"
-    (logdir / "checkpoints").mkdir(parents=True)
+    (logdir / "checkpoints" / "ckpt-80").mkdir(parents=True)
     stub = workdir / "train.py"
     stub.write_text(_STUB)
     stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
@@ -99,16 +106,61 @@ def test_equals_form_logdir_is_parsed(tmp_path):
     assert "--load" in recorded[1]
 
 
-def test_caller_passed_load_is_not_duplicated(tmp_path):
+def test_caller_load_replaced_by_run_checkpoints_on_relaunch(tmp_path):
+    """A caller --load is a warm-START source. On an exit-75 relaunch the
+    run's own $LOGDIR/checkpoints must take precedence — resuming from the
+    stale warm-start dir would discard all progress since launch (ADVICE
+    r4 #1: recurring rank failures would replay the same span forever)."""
     rc, calls, err = _run(
         tmp_path, [75, 0], extra_args=["--load", "/some/ckpts"],
         with_ckpt_dir=True,
     )
     assert rc == 0, err
-    # the script must not append a second --load overriding the caller's
+    # first launch: the caller's warm start, untouched
+    assert calls[0].count("--load") == 1
+    assert calls[0][calls[0].index("--load") + 1] == "/some/ckpts"
+    # relaunch: exactly ONE --load, pointing at the run's own checkpoints
+    assert calls[1].count("--load") == 1
+    assert calls[1][calls[1].index("--load") + 1].endswith("checkpoints")
+
+
+def test_caller_load_equals_form_replaced_on_relaunch(tmp_path):
+    rc, calls, err = _run(
+        tmp_path, [75, 0], extra_args=["--load=/some/ckpts"],
+        with_ckpt_dir=True,
+    )
+    assert rc == 0, err
+    assert "--load=/some/ckpts" in calls[0]
+    assert "--load=/some/ckpts" not in calls[1]
+    assert calls[1].count("--load") == 1
+    assert calls[1][calls[1].index("--load") + 1].endswith("checkpoints")
+
+
+def test_caller_load_kept_when_no_run_checkpoints_yet(tmp_path):
+    """Exit 75 before the first collective save: the run-local checkpoint
+    dir EXISTS (CheckpointManager creates it at startup) but holds no
+    saved checkpoint — the warm start is still the right resume point;
+    resuming from the empty dir would crash and strand the allocation."""
+    rc, calls, err = _run(
+        tmp_path, [75, 0], extra_args=["--load", "/some/ckpts"],
+        with_ckpt_dir=True, ckpt_saved=False,
+    )
+    assert rc == 0, err
     for c in calls:
         assert c.count("--load") == 1
         assert c[c.index("--load") + 1] == "/some/ckpts"
+
+
+def test_fresh_run_empty_ckpt_dir_relaunches_fresh(tmp_path):
+    """No caller --load and no saved checkpoint: relaunch must stay fresh
+    (no --load pointing at the empty startup-created dir)."""
+    rc, calls, err = _run(
+        tmp_path, [75, 0], extra_args=[], with_ckpt_dir=True,
+        ckpt_saved=False,
+    )
+    assert rc == 0, err
+    for c in calls:
+        assert "--load" not in c
 
 
 def test_nonzero_non75_exit_propagates(tmp_path):
