@@ -70,9 +70,12 @@ class LockstepWatchdog:
 
     #: effective limit grows to MARGIN x the slowest healthy beat interval
     #: ever observed — a run whose windows legitimately creep past the
-    #: configured bound raises its own limit instead of suiciding, while
-    #: detection stays bounded (a dead peer stops producing intervals, so
-    #: the limit freezes at MARGIN x the slowest healthy window).
+    #: configured bound raises its own limit instead of suiciding. The
+    #: ratchet is capped at ``first_timeout_s`` (3x the configured bound by
+    #: default): without a cap each healthy window may be up to the current
+    #: limit, compounding it geometrically, and a gradually degrading run
+    #: would never be detected. Detection latency is therefore bounded by
+    #: max(timeout_s, first_timeout_s) at all times.
     MARGIN = 2.0
 
     def __init__(
@@ -115,15 +118,20 @@ class LockstepWatchdog:
         # timeout_s == 0 means disarmed (no watcher thread): skip the
         # derived-limit bookkeeping and its log lines entirely
         if self._beaten and not self._graced and self.timeout_s > 0:
-            derived = self.MARGIN * (now - self._last)
+            # cap the ratchet at the first-beat grace: each healthy window
+            # can otherwise be up to the CURRENT limit, compounding the
+            # limit geometrically — a gradually degrading run would never
+            # be detected, and detection latency must stay bounded
+            derived = min(self.MARGIN * (now - self._last), self.first_timeout_s)
             if derived > self._derived_limit:
                 self._derived_limit = derived
                 if derived > self.timeout_s:
                     logger.info(
                         "%s: slowest healthy window %.0fs — stall limit "
-                        "raised to %.0fs (%.1fx margin; configured %.0fs)",
+                        "raised to %.0fs (%.1fx margin; configured %.0fs, "
+                        "cap %.0fs)",
                         self.what, now - self._last, derived,
-                        self.MARGIN, self.timeout_s,
+                        self.MARGIN, self.timeout_s, self.first_timeout_s,
                     )
         self._last = now
         self._beaten = True
@@ -134,7 +142,9 @@ class LockstepWatchdog:
             limit = (
                 max(self.timeout_s, self._derived_limit)
                 if self._beaten and not self._graced
-                else self.first_timeout_s
+                # graced/pre-first-beat windows must never be TIGHTER than
+                # what a normal window has already earned via the ratchet
+                else max(self.first_timeout_s, self._derived_limit)
             )
             stalled = time.monotonic() - self._last
             if stalled > limit:

@@ -53,13 +53,23 @@ while :; do
   # reusing it verbatim would discard every checkpoint saved since launch
   # (recurring rank failures would replay the same training span forever).
   if [[ $relaunch -eq 1 ]]; then
-    # an ACTUAL saved checkpoint, not just the dir: CheckpointManager
-    # creates $LOGDIR/checkpoints at startup before any save, so a rank
-    # failure during the first epoch leaves an empty dir — resuming from
-    # it would crash (and discard a caller warm start)
+    # a FINALIZED saved checkpoint, not just the dir or a ckpt-* entry:
+    # CheckpointManager creates $LOGDIR/checkpoints at startup, and a rank
+    # killed mid-save leaves orbax temp dirs / finalized dirs whose
+    # checkpoint.json "latest" was never written — resuming from any of
+    # those crashes with exit 1 and permanently kills the retry loop (and
+    # discards a caller warm start). The meta's non-null "latest" is the
+    # only resumable signal (written strictly after wait_until_finished).
     have_run_ckpt=0
-    if [[ -n "$LOGDIR" ]] && compgen -G "$LOGDIR/checkpoints/ckpt-*" > /dev/null; then
-      have_run_ckpt=1
+    if [[ -n "$LOGDIR" && -f "$LOGDIR/checkpoints/checkpoint.json" ]]; then
+      if python3 - "$LOGDIR/checkpoints/checkpoint.json" <<'EOF'
+import json, sys
+meta = json.load(open(sys.argv[1]))
+sys.exit(0 if meta.get("latest") is not None else 1)
+EOF
+      then
+        have_run_ckpt=1
+      fi
     fi
     if [[ $have_run_ckpt -eq 1 ]]; then
       if [[ $CALLER_LOADS -eq 1 ]]; then

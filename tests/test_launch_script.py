@@ -45,6 +45,9 @@ def _run(tmp_path, exit_codes, extra_args, with_ckpt_dir, ckpt_saved=True):
         (logdir / "checkpoints").mkdir(exist_ok=True)
         if ckpt_saved:
             (logdir / "checkpoints" / "ckpt-80").mkdir(exist_ok=True)
+            (logdir / "checkpoints" / "checkpoint.json").write_text(
+                json.dumps({"all": [80], "latest": 80})
+            )
     calls = workdir / "calls.json"
     env = dict(os.environ)
     env["STUB_CALLS"] = str(calls)
@@ -89,6 +92,9 @@ def test_equals_form_logdir_is_parsed(tmp_path):
     workdir.mkdir()
     logdir = workdir / "logs"
     (logdir / "checkpoints" / "ckpt-80").mkdir(parents=True)
+    (logdir / "checkpoints" / "checkpoint.json").write_text(
+        json.dumps({"all": [80], "latest": 80})
+    )
     stub = workdir / "train.py"
     stub.write_text(_STUB)
     stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
@@ -149,6 +155,39 @@ def test_caller_load_kept_when_no_run_checkpoints_yet(tmp_path):
     for c in calls:
         assert c.count("--load") == 1
         assert c[c.index("--load") + 1] == "/some/ckpts"
+
+
+def test_unfinalized_meta_is_not_resumable(tmp_path):
+    """A rank killed mid-FIRST-save leaves ckpt-* entries (or orbax temp
+    dirs) with checkpoint.json's 'latest' still null — resuming from that
+    would exit 1 and permanently kill the retry loop. The caller's warm
+    start must be kept."""
+    workdir = tmp_path / "wd"
+    workdir.mkdir()
+    logdir = workdir / "logs"
+    ck = logdir / "checkpoints"
+    (ck / "ckpt-80.orbax-checkpoint-tmp-123").mkdir(parents=True)
+    (ck / "checkpoint.json").write_text(
+        json.dumps({"all": [], "latest": None})
+    )
+    stub = workdir / "train.py"
+    stub.write_text(_STUB)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    calls = workdir / "calls.json"
+    env = dict(os.environ)
+    env["STUB_CALLS"] = str(calls)
+    env["STUB_EXIT_CODES"] = json.dumps([75, 0])
+    env["SLURM_PROCID"] = "0"
+    p = subprocess.run(
+        ["bash", _SCRIPT, "h1:9900,h2:9900", "--logdir", str(logdir),
+         "--load", "/warm/ckpts"],
+        cwd=workdir, env=env, capture_output=True, text=True, timeout=60,
+    )
+    assert p.returncode == 0, p.stderr
+    recorded = json.load(open(calls))
+    for c in recorded:
+        assert c.count("--load") == 1
+        assert c[c.index("--load") + 1] == "/warm/ckpts"
 
 
 def test_fresh_run_empty_ckpt_dir_relaunches_fresh(tmp_path):

@@ -115,13 +115,15 @@ def test_gradual_window_creep_raises_limit():
 import sys, time
 sys.path.insert(0, %r)
 from distributed_ba3c_tpu.parallel.watchdog import LockstepWatchdog
-with LockstepWatchdog(0.5, what="unit") as wd:
+with LockstepWatchdog(0.8, what="unit") as wd:
     # each window fits the CURRENT limit with real headroom (the first
     # beat doesn't ratchet — pre-first-beat runs on the 3x grace), and
-    # they grow past the configured 0.5s: 0.4 -> derived 0.8; 0.7 -> 1.4
-    for w in (0.3, 0.4, 0.7):
+    # they grow past the configured 0.8s: 0.6 -> derived 1.2; 0.9 -> 1.8
+    # (still under the 2.4s first-timeout cap)
+    for w in (0.4, 0.6, 0.9):
         time.sleep(w)
         wd.beat()
+    assert wd._derived_limit <= wd.first_timeout_s  # ratchet is capped
     print("CREPT", flush=True)
     time.sleep(30)              # stall: must fire at the raised limit
 print("UNREACHABLE", flush=True)
