@@ -246,9 +246,18 @@ def main():
     )
     args = ap.parse_args()
 
+    import os
+
     from distributed_ba3c_tpu.utils.devicelock import guard_tpu
 
-    _lock = guard_tpu("bench.py", mode=args.tpu_lock)  # noqa: F841 — held for process lifetime
+    # bounded wait: the driver invokes bench.py unattended at round end —
+    # queueing briefly behind a finishing run is right, hanging forever
+    # behind a wedged one is not (exit nonzero with the holder identity)
+    _lock = guard_tpu(  # noqa: F841 — held for process lifetime
+        "bench.py",
+        mode=args.tpu_lock,
+        timeout_s=float(os.environ.get("BA3C_TPU_LOCK_TIMEOUT", "1800")),
+    )
     if args.plane == "zmq":
         print(json.dumps(bench_zmq_plane()))
     elif args.plane == "zmq-null":

@@ -32,14 +32,21 @@ from __future__ import annotations
 
 import errno
 import fcntl
+import functools
 import json
 import os
+import sys
 import time
 from typing import Callable, Optional
 
 LOCK_PATH_ENV = "BA3C_TPU_LOCK"
 DEFAULT_LOCK_PATH = "/tmp/ba3c_tpu.lock"
 MODES = ("wait", "fail", "off")
+
+# diagnostics go to STDERR: bench.py and the eval scripts print exactly one
+# JSON line on stdout for machine consumption — a "[tpu-lock] waiting" line
+# there would corrupt the contract
+_stderr_print = functools.partial(print, file=sys.stderr, flush=True)
 
 
 def lock_path() -> str:
@@ -133,7 +140,7 @@ class TpuLock:
         mode: str = "wait",
         poll_s: float = 5.0,
         timeout_s: Optional[float] = None,
-        log: Callable[[str], None] = print,
+        log: Callable[[str], None] = _stderr_print,
     ) -> "TpuLock":
         if mode not in MODES:
             raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
@@ -190,7 +197,7 @@ def guard_tpu(
     mode: str = "wait",
     poll_s: float = 5.0,
     timeout_s: Optional[float] = None,
-    log: Callable[[str], None] = print,
+    log: Callable[[str], None] = _stderr_print,
 ) -> Optional[TpuLock]:
     """Entry-point helper: acquire the host-local TPU claim unless this
     process is on the CPU platform (or mode='off'). Call BEFORE the first

@@ -18,10 +18,17 @@ HERE=$(cd "$(dirname "$0")/.." && pwd)
 attempt=0
 while :; do
   args=("$@")
-  # resume whenever checkpoints exist — including a FRESH launcher
-  # invocation over a prior run's logdir (restarting from step 0 would
-  # clobber the existing checkpoints)
-  if [ -d "$LOGDIR/checkpoints" ]; then
+  # resume whenever a FINALIZED checkpoint exists — including a FRESH
+  # launcher invocation over a prior run's logdir (restarting from step 0
+  # would clobber the existing checkpoints). Gate on checkpoint.json's
+  # non-null "latest" (written only after wait_until_finished), NOT on the
+  # dir: CheckpointManager creates the dir at startup, so a stall-kill
+  # before the first save would otherwise make every subsequent attempt
+  # --load an empty dir, crash with exit 1, and burn MAX_RESTARTS on a
+  # run that never trained (same gate as launch_multihost.sh).
+  if [ -f "$LOGDIR/checkpoints/checkpoint.json" ] && \
+     python3 -c 'import json,sys; sys.exit(0 if json.load(open(sys.argv[1])).get("latest") is not None else 1)' \
+       "$LOGDIR/checkpoints/checkpoint.json" 2>/dev/null; then
     args+=(--load "$LOGDIR/checkpoints")
   fi
   echo "[run_with_resume] attempt $attempt: python train.py ${args[*]}" >&2

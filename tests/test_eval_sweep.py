@@ -71,8 +71,12 @@ def test_n_eval_rounds_up_to_data_axis_multiple(tmp_path):
         assert n_eval % n_data == 0
 
 
-def _run_sweep(monkeypatch, tmp_path, argv_tail):
-    mod = _load_sweep_module()
+def _run_sweep(monkeypatch, tmp_path, argv_tail, mod=None):
+    """Drive the sweep script's main() with argv; return the written JSON.
+
+    Pass ``mod`` to run a module whose evaluator factory was patched
+    beforehand (the scripted-evaluator tests)."""
+    mod = mod or _load_sweep_module()
     out = str(tmp_path / "sweep.json")
     monkeypatch.setattr(
         "sys.argv", ["eval_sweep.py", "--out", out] + argv_tail
@@ -135,16 +139,12 @@ def _scripted_evaluator(mod, means_by_step):
 def test_earliest_crossing_selected(monkeypatch, tiny_run, tmp_path):
     mod = _load_sweep_module()
     _scripted_evaluator(mod, {2: 10.0, 4: 19.0, 6: 20.0})
-    out = str(tmp_path / "sweep.json")
-    monkeypatch.setattr("sys.argv", [
-        "eval_sweep.py", "--out", out,
+    summary, _ = _run_sweep(monkeypatch, tmp_path, [
         "--env", "jax:pong",
         "--load", os.path.join(tiny_run, "checkpoints"),
         "--nr_eval", "8", "--max_steps", "8",
         "--threshold", "18", "--fc_units", "16",
-    ])
-    mod.main()
-    summary = json.load(open(out))
+    ], mod=mod)
     # earliest step clearing 18 is 4 — NOT the higher-scoring 6
     assert summary["earliest_at_threshold"]["step"] == 4
     assert summary["earliest_at_threshold"]["eval_mean"] == 19.0
@@ -155,17 +155,13 @@ def test_earliest_crossing_selected(monkeypatch, tiny_run, tmp_path):
 def test_steps_subset_narrows_sweep(monkeypatch, tiny_run, tmp_path):
     mod = _load_sweep_module()
     _scripted_evaluator(mod, {2: 10.0, 4: 19.0, 6: 20.0})
-    out = str(tmp_path / "sweep.json")
-    monkeypatch.setattr("sys.argv", [
-        "eval_sweep.py", "--out", out,
+    summary, _ = _run_sweep(monkeypatch, tmp_path, [
         "--env", "jax:pong",
         "--load", os.path.join(tiny_run, "checkpoints"),
         "--steps", "6",
         "--nr_eval", "8", "--max_steps", "8",
         "--threshold", "18", "--fc_units", "16",
-    ])
-    mod.main()
-    summary = json.load(open(out))
+    ], mod=mod)
     assert [r["step"] for r in summary["results"]] == [6]
     assert summary["earliest_at_threshold"]["step"] == 6
 
@@ -188,15 +184,11 @@ def test_partial_completion_below_gate_is_not_certified(
         return mgr, target, (lambda p, s: (99.0, 99.0, int(0.75 * n_eval))), n_eval
 
     mod.make_checkpoint_evaluator = fake
-    out = str(tmp_path / "sweep.json")
-    monkeypatch.setattr("sys.argv", [
-        "eval_sweep.py", "--out", out,
+    summary, _ = _run_sweep(monkeypatch, tmp_path, [
         "--env", "jax:pong",
         "--load", os.path.join(tiny_run, "checkpoints"),
         "--nr_eval", "8", "--max_steps", "8",
         "--threshold", "18", "--fc_units", "16",
-    ])
-    mod.main()
-    summary = json.load(open(out))
+    ], mod=mod)
     assert summary["earliest_at_threshold"] is None
     assert all(r["eval_mean"] == 99.0 for r in summary["results"])

@@ -1,0 +1,71 @@
+"""run_with_resume.sh resume-gate contract, with a stubbed train.py.
+
+Same gate as launch_multihost.sh (tests/test_launch_script.py): resume
+only from a FINALIZED checkpoint (checkpoint.json "latest" non-null).
+CheckpointManager creates the checkpoints dir at startup, so a stall-kill
+before the first save must NOT make subsequent attempts --load an empty
+dir (exit-1 crash burning MAX_RESTARTS on a run that never trained).
+jax-free: the script resolves train.py relative to its own location, so
+the stub lives in a copied tree.
+"""
+
+import json
+import os
+import shutil
+import stat
+import subprocess
+
+_SCRIPT = os.path.join(
+    os.path.dirname(os.path.dirname(__file__)), "scripts", "run_with_resume.sh"
+)
+
+_STUB = r"""#!/usr/bin/env python3
+import json, os, sys
+calls_path = os.environ["STUB_CALLS"]
+calls = json.load(open(calls_path)) if os.path.exists(calls_path) else []
+calls.append(sys.argv[1:])
+json.dump(calls, open(calls_path, "w"))
+sys.exit(0)
+"""
+
+
+def _run(tmp_path, meta):
+    """Copy the script into a stub tree; return the first attempt's argv."""
+    tree = tmp_path / "tree"
+    (tree / "scripts").mkdir(parents=True)
+    shutil.copy(_SCRIPT, tree / "scripts" / "run_with_resume.sh")
+    stub = tree / "train.py"
+    stub.write_text(_STUB)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    logdir = tree / "runs" / "x"
+    (logdir / "checkpoints").mkdir(parents=True)
+    if meta is not None:
+        (logdir / "checkpoints" / "checkpoint.json").write_text(
+            json.dumps(meta)
+        )
+    calls = tree / "calls.json"
+    env = dict(os.environ)
+    env["STUB_CALLS"] = str(calls)
+    p = subprocess.run(
+        ["bash", str(tree / "scripts" / "run_with_resume.sh"),
+         str(logdir), "2", "60", "--", "--logdir", str(logdir)],
+        cwd=tree, env=env, capture_output=True, text=True, timeout=60,
+    )
+    assert p.returncode == 0, p.stderr
+    return json.load(open(calls))[0]
+
+
+def test_finalized_checkpoint_resumes(tmp_path):
+    argv = _run(tmp_path, {"all": [80], "latest": 80})
+    assert "--load" in argv
+    assert argv[argv.index("--load") + 1].endswith("checkpoints")
+
+
+def test_unfinalized_meta_starts_fresh(tmp_path):
+    argv = _run(tmp_path, {"all": [], "latest": None})
+    assert "--load" not in argv
+
+
+def test_startup_created_dir_without_meta_starts_fresh(tmp_path):
+    argv = _run(tmp_path, None)
+    assert "--load" not in argv
