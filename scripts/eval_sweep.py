@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
@@ -58,12 +59,42 @@ def main():
     if not steps:
         raise SystemExit(f"no checkpoints recorded under {args.load}")
 
+    out = args.out or f"{args.load}/../eval_sweep.json"
     results = []
     earliest = None
+
+    def write_summary(complete):
+        summary = {
+            "load": args.load,
+            "nr_eval_requested": args.nr_eval,
+            "n_eval_envs": n_eval,
+            "max_steps": args.max_steps,
+            "threshold": args.threshold,
+            "seed_stream": "777000+step, disjoint from training's 1000+epoch",
+            "results": results,
+            "earliest_at_threshold": earliest,
+            "sweep_complete": complete,
+        }
+        tmp = out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(summary, f, indent=1)
+        os.replace(tmp, out)
+
     for step in steps:
-        state = mgr.restore(target, step)
-        # integer seed stream provably disjoint from training's 1000+epoch
-        mean, mx, n = evaluate(state.params, 777000 + step)
+        try:
+            state = mgr.restore(target, step)
+            # integer seed stream provably disjoint from training's
+            # 1000+epoch
+            mean, mx, n = evaluate(state.params, 777000 + step)
+        except Exception as e:
+            # one bad checkpoint (or a tunnel wedge surfacing as a device
+            # error) must not discard the evals already done — the sweep
+            # IS the verification artifact; record the failure and go on
+            rec = {"step": step, "error": f"{type(e).__name__}: {e}"}
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+            write_summary(complete=False)
+            continue
         # n==0 => mean/max are fill values (-inf is not even valid JSON)
         rec = {"step": step,
                "eval_mean": round(mean, 3) if n > 0 else None,
@@ -81,19 +112,10 @@ def main():
             and mean >= args.threshold
         ):
             earliest = rec
-    summary = {
-        "load": args.load,
-        "nr_eval_requested": args.nr_eval,
-        "n_eval_envs": n_eval,
-        "max_steps": args.max_steps,
-        "threshold": args.threshold,
-        "seed_stream": "777000+step, disjoint from training's 1000+epoch",
-        "results": results,
-        "earliest_at_threshold": earliest,
-    }
-    out = args.out or f"{args.load}/../eval_sweep.json"
-    with open(out, "w") as f:
-        json.dump(summary, f, indent=1)
+        # incremental write: a crash at checkpoint k keeps evals 1..k
+        # (26 x ~1 min on a flaky tunnel is a real loss surface)
+        write_summary(complete=False)
+    write_summary(complete=not any("error" in r for r in results))
     print(f"wrote {out}", flush=True)
     if args.threshold is not None:
         print(

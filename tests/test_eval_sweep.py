@@ -166,6 +166,53 @@ def test_steps_subset_narrows_sweep(monkeypatch, tiny_run, tmp_path):
     assert summary["earliest_at_threshold"]["step"] == 6
 
 
+def test_midsweep_failure_keeps_prior_results_and_continues(
+    monkeypatch, tiny_run, tmp_path
+):
+    """One bad checkpoint (corrupt save, tunnel wedge surfacing as a
+    device error) must not discard the evals already done — the sweep IS
+    the verification artifact. The failed step gets an error record, the
+    sweep continues, and the summary marks itself incomplete."""
+    mod = _load_sweep_module()
+    import distributed_ba3c_tpu.train.eval_tools as et
+
+    real = et.make_checkpoint_evaluator
+
+    def fake(env_spec, load, nr_eval, max_steps, fc_units=512):
+        mgr, target, _e, n_eval = real(
+            env_spec, load, nr_eval, max_steps, fc_units
+        )
+        calls = {"step": None}
+        real_restore = mgr.restore
+
+        def restore(t, step=None):
+            if step == 4:
+                raise RuntimeError("corrupt checkpoint")
+            state = real_restore(t, step)
+            calls["step"] = int(state.step)
+            return state
+
+        mgr.restore = restore
+        means = {2: 10.0, 6: 20.0}
+        return (
+            mgr, target,
+            (lambda p, s: (means[calls["step"]], 21.0, n_eval)), n_eval,
+        )
+
+    mod.make_checkpoint_evaluator = fake
+    summary, _ = _run_sweep(monkeypatch, tmp_path, [
+        "--env", "jax:pong",
+        "--load", os.path.join(tiny_run, "checkpoints"),
+        "--nr_eval", "8", "--max_steps", "8",
+        "--threshold", "18", "--fc_units", "16",
+    ], mod=mod)
+    assert [r["step"] for r in summary["results"]] == [2, 4, 6]
+    assert "corrupt checkpoint" in summary["results"][1]["error"]
+    assert summary["results"][2]["eval_mean"] == 20.0  # continued past it
+    assert summary["earliest_at_threshold"]["step"] == 6
+    assert summary["sweep_complete"] is False
+
+
 def test_partial_completion_below_gate_is_not_certified(
     monkeypatch, tiny_run, tmp_path
 ):
