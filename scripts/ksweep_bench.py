@@ -1,0 +1,70 @@
+"""K-sweep at the BENCH shape: env-steps/s/chip vs --steps_per_dispatch K.
+
+Replaces round 4's contaminated sweep (PERF.md) with a committed
+methodology: delegates to ``bench.bench_fused`` so the measurement policy
+(state creation, warmup-and-drain, 3 fully-synced windows, best window
+wins) lives in exactly one place — for each K the window's ``iters``
+updates run as ``iters/K`` dispatches of one K-step scanned program.
+Run on an idle chip — the TPU-claim mutex queues (bounded) or refuses if
+another local process holds it.
+
+Prints per-K diagnostics on stderr and ONE JSON line on stdout
+(the repo's bench-tooling contract, utils/devicelock.py).
+
+Usage: python scripts/ksweep_bench.py [--ks 1,20,200] [--tpu_lock wait|fail|off]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from distributed_ba3c_tpu.utils.devicelock import _stderr_print, guard_tpu  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n_envs", type=int, default=128)
+    ap.add_argument("--rollout_len", type=int, default=20)
+    ap.add_argument("--total", type=int, default=200,
+                    help="updates per timed window (must be divisible by each K)")
+    ap.add_argument("--ks", default="1,20,200")
+    ap.add_argument("--tpu_lock", default="wait", choices=["wait", "fail", "off"])
+    args = ap.parse_args()
+
+    _lock = guard_tpu(  # noqa: F841 — held for process lifetime
+        "ksweep_bench",
+        mode=args.tpu_lock,
+        timeout_s=float(os.environ.get("BA3C_TPU_LOCK_TIMEOUT", "1800")),
+    )
+
+    from bench import bench_fused
+
+    out: dict[int, float] = {}
+    windows: dict[int, list[float]] = {}
+    for K in (int(k) for k in args.ks.split(",")):
+        r = bench_fused(
+            n_envs=args.n_envs, rollout_len=args.rollout_len,
+            iters=args.total, steps_per_dispatch=K,
+        )
+        out[K] = r["value"]
+        windows[K] = r["window_rates"]
+        _stderr_print(
+            f"K={K}: {r['value']} env-steps/s/chip  windows={r['window_rates']}"
+        )
+    print(json.dumps({
+        "metric": "fused_pong_ksweep_env_steps_per_sec_per_chip",
+        "shape": f"{args.n_envs}x{args.rollout_len}",
+        "total_updates_per_window": args.total,
+        "per_chip_by_K": out,
+        "windows_by_K": windows,
+    }))
+
+
+if __name__ == "__main__":
+    main()
