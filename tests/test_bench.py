@@ -1,0 +1,68 @@
+"""bench.py's measurement surface: K validation + the K-sweep JSON contract.
+
+The bench number is the driver's round metric, and round 4's contaminated
+K-sweep showed what an untested measurement path costs — these tests pin
+the parts that don't need a chip: the steps_per_dispatch contract on
+``bench_fused`` and ``scripts/ksweep_bench.py``'s one-JSON-line stdout
+(diagnostics on stderr) including the windows_by_K provenance field the
+committed artifact (runs/ksweep_r5.json) carries.
+"""
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import bench  # noqa: E402
+
+
+@pytest.mark.parametrize("bad_k", [0, -1, 3])
+def test_bench_fused_rejects_bad_steps_per_dispatch(bad_k):
+    # K=3 does not divide iters=8; 0/-1 are out of range. All must raise
+    # the designed ValueError BEFORE any compile/dispatch work.
+    with pytest.raises(ValueError, match="steps_per_dispatch"):
+        bench.bench_fused(n_envs=8, rollout_len=4, iters=8,
+                          steps_per_dispatch=bad_k)
+
+
+def _load_ksweep_module():
+    spec = importlib.util.spec_from_file_location(
+        "ksweep_bench", REPO / "scripts" / "ksweep_bench.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_ksweep_stdout_is_one_json_line_with_windows(monkeypatch, capsys):
+    mod = _load_ksweep_module()
+
+    def fake_bench_fused(n_envs, rollout_len, iters, steps_per_dispatch):
+        assert iters % steps_per_dispatch == 0
+        return {
+            "value": 100.0 + steps_per_dispatch,
+            "window_rates": [90.0, 100.0 + steps_per_dispatch, 95.0],
+        }
+
+    monkeypatch.setattr(bench, "bench_fused", fake_bench_fused)
+    monkeypatch.setattr(mod, "guard_tpu", lambda *a, **kw: None)
+    monkeypatch.setattr(
+        sys, "argv",
+        ["ksweep_bench.py", "--ks", "1,4", "--total", "8", "--tpu_lock", "off"],
+    )
+    mod.main()
+
+    captured = capsys.readouterr()
+    lines = [ln for ln in captured.out.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"stdout must be ONE JSON line, got: {lines}"
+    payload = json.loads(lines[0])
+    assert payload["per_chip_by_K"] == {"1": 101.0, "4": 104.0}
+    assert payload["windows_by_K"]["1"] == [90.0, 101.0, 95.0]
+    assert payload["windows_by_K"]["4"] == [90.0, 104.0, 95.0]
+    # per-K progress goes to stderr, never stdout
+    assert "env-steps/s/chip" in captured.err
