@@ -19,6 +19,7 @@ from distributed_ba3c_tpu.actors.simulator import (
     TransitionExperience,
 )
 from distributed_ba3c_tpu.predict.server import BatchedPredictor
+from distributed_ba3c_tpu.utils import sanitizer
 
 
 class BA3CSimulatorMaster(SimulatorMaster):
@@ -44,13 +45,22 @@ class BA3CSimulatorMaster(SimulatorMaster):
         self.gamma = gamma
         self.local_time_max = local_time_max
         # bounded like the reference's FIFOQueue: backpressure pauses actors
-        self.queue: queue.Queue = train_queue or queue.Queue(maxsize=4096)
+        self.queue: queue.Queue = sanitizer.wrap_queue(
+            train_queue or queue.Queue(maxsize=4096),
+            name="BA3CSimulatorMaster.queue",
+        )
         self.score_queue = score_queue
 
     def _on_state(self, state: np.ndarray, ident: bytes) -> None:
         def cb(action: int, value: float, logp: float):
             client = self.clients[ident]
-            client.memory.append(TransitionExperience(state, action, value))
+            # safe cross-thread append: the simulator is blocked awaiting
+            # this very action, so the master cannot touch client.memory
+            # until send_action below releases it (protocol serialization;
+            # the BA3C_SANITIZE=1 job watches the table half of this claim)
+            client.memory.append(  # ba3clint: disable=A3
+                TransitionExperience(state, action, value)
+            )
             self.send_action(ident, action)
 
         self.predictor.put_task(state, cb)
@@ -80,5 +90,9 @@ class BA3CSimulatorMaster(SimulatorMaster):
         R = float(init_r)
         for k in reversed(mem):
             R = k.reward + self.gamma * R
-            self.queue.put([k.state, k.action, np.float32(R)])
+            # backpressure pauses actors, but must stay shutdown-responsive
+            if not self._put_stoppable(
+                self.queue, [k.state, k.action, np.float32(R)]
+            ):
+                return  # master stopped while the learner was backed up
         client.memory = [] if is_over else [last]

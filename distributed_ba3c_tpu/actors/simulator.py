@@ -21,14 +21,18 @@ import multiprocessing as mp
 import os
 import queue
 import threading
+import time
 from abc import abstractmethod
-from collections import defaultdict
 from typing import Callable, Dict, List, Optional
 
 import zmq
 
 from distributed_ba3c_tpu.envs.base import RLEnvironment
-from distributed_ba3c_tpu.utils import logger
+from distributed_ba3c_tpu.utils import logger, sanitizer
+from distributed_ba3c_tpu.utils.concurrency import (
+    StoppableThread,
+    queue_put_stoppable,
+)
 from distributed_ba3c_tpu.utils.serialize import dumps, loads
 
 
@@ -50,15 +54,15 @@ class ClientState:
     __slots__ = ("memory", "ident", "score", "last_seen")
 
     def __init__(self, ident: bytes):
-        import time as _time
-
         self.ident = ident
         self.memory: List[TransitionExperience] = []
         self.score = 0.0
         # initialized to creation time so a client that NEVER sends again
         # (e.g. resurrected by a late predictor callback after pruning) still
-        # ages out instead of being exempt forever
-        self.last_seen = _time.time()
+        # ages out instead of being exempt forever. MONOTONIC, not wall
+        # clock: an NTP step/suspend would otherwise mass-expire (or
+        # immortalize) every actor at once (ba3clint A4 caught this).
+        self.last_seen = time.monotonic()
 
 
 def default_pipes(name: str = "ba3c") -> tuple[str, str]:
@@ -160,26 +164,32 @@ class SimulatorMaster(threading.Thread):
         self.s2c_socket.bind(pipe_s2c)
         self.s2c_socket.set_hwm(32)
 
-        self.clients: Dict[bytes, ClientState] = defaultdict(
-            lambda: ClientState(b"")
+        # sanitizer wrapping (BA3C_SANITIZE=1 in tests): the client table's
+        # structure is owned by the receive loop, the send queue has exactly
+        # one drain thread — plain defaultdict/Queue when disabled
+        self.clients: Dict[bytes, ClientState] = sanitizer.wrap_client_table(
+            lambda: ClientState(b""), name="SimulatorMaster.clients"
         )
-        self.send_queue: "queue.Queue[list]" = queue.Queue(maxsize=1024)
+        self.send_queue: "queue.Queue[list]" = sanitizer.wrap_queue(
+            queue.Queue(maxsize=1024), name="SimulatorMaster.send_queue"
+        )
         self._stop_evt = threading.Event()
 
         def send_loop():
-            while not self._stop_evt.is_set():
-                try:
-                    msg = self.send_queue.get(timeout=0.2)
-                except queue.Empty:
-                    continue
+            t = threading.current_thread()
+            assert isinstance(t, StoppableThread)
+            while not t.stopped():
+                msg = t.queue_get_stoppable(self.send_queue, timeout=0.2)
+                if msg is None:
+                    return
                 try:
                     self.s2c_socket.send_multipart(msg)
                 except zmq.ZMQError:
-                    if self._stop_evt.is_set():
+                    if t.stopped() or self._stop_evt.is_set():
                         return  # socket closed during teardown
                     raise
 
-        self.send_thread = threading.Thread(
+        self.send_thread = StoppableThread(
             target=send_loop, daemon=True, name="SimulatorMaster-send"
         )
         self.send_thread.start()
@@ -187,7 +197,10 @@ class SimulatorMaster(threading.Thread):
     def run(self) -> None:
         poller = zmq.Poller()
         poller.register(self.c2s_socket, zmq.POLLIN)
-        import time as _time
+        # this receive loop is the structural owner of the client table;
+        # the sanitizer (when enabled) flags any other thread that
+        # creates/deletes entries
+        sanitizer.claim_owner(self.clients)
 
         try:
             while not self._stop_evt.is_set():
@@ -200,7 +213,7 @@ class SimulatorMaster(threading.Thread):
                 ident, state, reward, is_over = loads(self.c2s_socket.recv())
                 client = self.clients[ident]
                 client.ident = ident
-                client.last_seen = _time.time()
+                client.last_seen = time.monotonic()
                 self._on_message(ident, state, reward, is_over)
         except zmq.ContextTerminated:
             logger.info("SimulatorMaster context terminated")
@@ -216,9 +229,7 @@ class SimulatorMaster(threading.Thread):
         tolerated: its partial rollout is discarded, training continues)."""
         if self.actor_timeout is None:
             return
-        import time as _time
-
-        now = _time.time()
+        now = time.monotonic()
         if now - self._last_prune < self.actor_timeout / 4:
             return
         self._last_prune = now
@@ -261,10 +272,17 @@ class SimulatorMaster(threading.Thread):
         return max(-c, min(c, reward)) if c else reward
 
     def send_action(self, ident: bytes, action: int) -> None:
-        self.send_queue.put([ident, dumps(int(action))])
+        self._put_stoppable(self.send_queue, [ident, dumps(int(action))])
+
+    def _put_stoppable(self, q: queue.Queue, item, timeout: float = 0.5) -> bool:
+        """Backpressure that stays shutdown-responsive: bounded-timeout puts
+        re-checking the stop flag (the plane's only sanctioned blocking put —
+        ba3clint A2). Returns False if the master stopped while waiting."""
+        return queue_put_stoppable(q, item, self._stop_evt, timeout)
 
     def stop(self) -> None:
         self._stop_evt.set()
+        self.send_thread.stop()
 
     def close(self) -> None:
         """Stop threads and tear down ZMQ without lingering sends.
@@ -274,6 +292,7 @@ class SimulatorMaster(threading.Thread):
         wedge later in-process jit dispatch — the round-1 pytest deadlock).
         """
         self._stop_evt.set()
+        self.send_thread.stop()
         self.send_thread.join(timeout=2)
         if self.is_alive():
             self.join(timeout=2)
@@ -297,6 +316,7 @@ class SimulatorMaster(threading.Thread):
     def __del__(self):
         try:
             self._stop_evt.set()
+            self.send_thread.stop()
             self.context.destroy(0)
         except Exception:
             pass

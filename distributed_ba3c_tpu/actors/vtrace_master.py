@@ -21,6 +21,7 @@ import numpy as np
 
 from distributed_ba3c_tpu.actors.simulator import SimulatorMaster
 from distributed_ba3c_tpu.predict.server import BatchedPredictor
+from distributed_ba3c_tpu.utils import sanitizer
 
 
 class _Step:
@@ -58,13 +59,20 @@ class VTraceSimulatorMaster(SimulatorMaster):
         )
         self.predictor = predictor
         self.unroll_len = unroll_len
-        self.queue: queue.Queue = train_queue or queue.Queue(maxsize=1024)
+        self.queue: queue.Queue = sanitizer.wrap_queue(
+            train_queue or queue.Queue(maxsize=1024),
+            name="VTraceSimulatorMaster.queue",
+        )
         self.score_queue = score_queue
 
     def _on_state(self, state: np.ndarray, ident: bytes) -> None:
         def cb(action: int, value: float, logp: float):
             client = self.clients[ident]
-            client.memory.append(_Step(state, action, logp))
+            # safe cross-thread append: the simulator is blocked awaiting
+            # this very action, so the master cannot reslice client.memory
+            # until send_action below releases it (protocol serialization;
+            # the BA3C_SANITIZE=1 job watches the table half of this claim)
+            client.memory.append(_Step(state, action, logp))  # ba3clint: disable=A3
             self.send_action(ident, action)
 
         self.predictor.put_task(state, cb)
@@ -121,4 +129,5 @@ class VTraceSimulatorMaster(SimulatorMaster):
             "bootstrap_state": rest[0].state,
         }
         client.memory = rest
-        self.queue.put(segment)
+        # backpressure pauses actors, but must stay shutdown-responsive
+        self._put_stoppable(self.queue, segment)

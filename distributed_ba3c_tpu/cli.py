@@ -247,8 +247,13 @@ def main(argv: Optional[list] = None) -> int:
         make_global_mesh,
     )
 
-    if _plat == "cpu" or not _plat:
-        # CPU cross-process collectives need gloo; harmless single-host.
+    _multi_host = len([h for h in args.worker_hosts.split(",") if h]) > 1
+    if (_plat == "cpu" or not _plat) and _multi_host:
+        # CPU cross-process collectives need gloo. Only when actually
+        # multi-process: recent jaxlib builds gloo against the distributed
+        # runtime client, and single-host (client=None) fails backend init
+        # (found by the BA3C_SANITIZE=1 e2e job — the backend error
+        # predates any actor traffic).
         try:
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         except Exception:

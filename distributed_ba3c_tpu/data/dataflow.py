@@ -17,6 +17,8 @@ from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
+from distributed_ba3c_tpu.utils.concurrency import StoppableThread
+
 
 class DataFlow(ABC):
     """A restartable stream of datapoints (lists of numpy-compatible items)."""
@@ -30,14 +32,27 @@ class DataFlow(ABC):
 
 
 class QueueDataFlow(DataFlow):
-    """Yields datapoints pulled from a (thread-safe) queue, forever."""
+    """Yields datapoints pulled from a (thread-safe) queue.
 
-    def __init__(self, q: "queue.Queue[list]"):
+    Runs until ``stop_event`` is set (forever when none is given) — the
+    bounded-timeout get keeps the consuming thread shutdown-responsive
+    instead of wedging on a dead producer (ba3clint A2).
+    """
+
+    def __init__(
+        self,
+        q: "queue.Queue[list]",
+        stop_event: Optional[threading.Event] = None,
+    ):
         self.q = q
+        self._stop = stop_event
 
     def get_data(self) -> Iterator[list]:
-        while True:
-            yield self.q.get()
+        while self._stop is None or not self._stop.is_set():
+            try:
+                yield self.q.get(timeout=0.5)
+            except queue.Empty:
+                continue
 
 
 class BatchData(DataFlow):
@@ -77,8 +92,7 @@ class _BatchFeed:
         self._out: "queue.Queue[Dict[str, np.ndarray]]" = queue.Queue(
             maxsize=prefetch
         )
-        self._stop = threading.Event()
-        self._thread = threading.Thread(
+        self._thread = StoppableThread(
             target=self._loop, daemon=True, name=type(self).__name__
         )
 
@@ -89,7 +103,7 @@ class _BatchFeed:
         self._thread.start()
 
     def stop(self) -> None:
-        self._stop.set()
+        self._thread.stop()
 
     def join(self, timeout: Optional[float] = None) -> None:
         """Wait for the batcher thread to exit (it polls with 0.2s timeout)."""
@@ -97,22 +111,20 @@ class _BatchFeed:
             self._thread.join(timeout)
 
     def _loop(self) -> None:
+        t = threading.current_thread()
+        assert isinstance(t, StoppableThread)
         holder: List = []
-        while not self._stop.is_set():
-            try:
-                holder.append(self.in_queue.get(timeout=0.2))
-            except queue.Empty:
-                continue
+        while not t.stopped():
+            item = t.queue_get_stoppable(self.in_queue, timeout=0.2)
+            if item is None:
+                return  # stopped while the actor plane was quiet
+            holder.append(item)
             if len(holder) < self.batch_size:
                 continue
             batch = self._collate(holder)
             holder = []
-            while not self._stop.is_set():
-                try:
-                    self._out.put(batch, timeout=0.2)
-                    break
-                except queue.Full:
-                    continue
+            if not t.queue_put_stoppable(self._out, batch, timeout=0.2):
+                return  # stopped while the learner was backed up
 
     def next_batch(self, timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
         return self._out.get(timeout=timeout)

@@ -45,7 +45,13 @@ from distributed_ba3c_tpu.models.a3c import BA3CNet
 from distributed_ba3c_tpu.ops.gradproc import grad_summaries, inject_learning_rate
 from distributed_ba3c_tpu.ops.loss import a3c_loss
 from distributed_ba3c_tpu.ops.returns import n_step_returns
-from distributed_ba3c_tpu.parallel.mesh import DATA_AXIS
+from distributed_ba3c_tpu.parallel.mesh import (
+    DATA_AXIS,
+    axis_size,
+    grad_allreduce,
+    shard_map,
+    to_varying,
+)
 from distributed_ba3c_tpu.parallel.train_step import TrainState
 
 #: metrics that accumulate IN STATE across an epoch (reset by the outer
@@ -249,7 +255,8 @@ def make_fused_step(
             )
             grads = jax.tree_util.tree_map(lambda g: g / n_chunks, grads)
             aux = jax.tree_util.tree_map(lambda a: a / n_chunks, aux_sum)
-        n_data = jax.lax.axis_size(DATA_AXIS)
+        grads = grad_allreduce(grads, DATA_AXIS)
+        n_data = axis_size(DATA_AXIS)
         grads = jax.tree_util.tree_map(lambda g: g / n_data, grads)
 
         opt_state = inject_learning_rate(state.train.opt_state, learning_rate)
@@ -317,7 +324,7 @@ def make_fused_step(
         ep_return_sum=batch_spec,
     )
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         multi_step,
         mesh=mesh,
         in_specs=(state_specs, P(), P()),
@@ -406,10 +413,9 @@ def make_greedy_eval(
         # reset() fields built from constants are axis-INVARIANT under
         # shard_map until the first data-dependent step, which breaks the
         # env's internal scan carries — mark the whole state varying up front
+        # (identity on old jax, where check_rep=False tracks no rep types)
         def _to_varying(x):
-            if DATA_AXIS in getattr(jax.typeof(x), "vma", frozenset()):
-                return x  # already varying (e.g. key-derived fields)
-            return jax.lax.pcast(x, (DATA_AXIS,), to="varying")
+            return to_varying(x, DATA_AXIS)
 
         env_state = jax.tree_util.tree_map(_to_varying, env_state)
         obs = jax.vmap(env.render)(env_state)
@@ -451,7 +457,7 @@ def make_greedy_eval(
         )
         return s / jnp.maximum(n, 1), mx, n
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_eval,
         mesh=mesh,
         in_specs=(P(), P()),
@@ -674,7 +680,7 @@ def _fused_epoch_body(
         beta = sched(cfg.entropy_beta, args.entropy_beta_final, epoch, beta_mode)
         lr = sched(cfg.learning_rate, args.learning_rate_final, epoch, lr_mode)
         lr, beta = live_hyper(lr, beta)
-        t0 = time.time()
+        t0 = time.monotonic()
         metrics = None
         for _ in range(args.steps_per_epoch // step.steps_per_dispatch):
             state, metrics = step(state, beta, lr)
@@ -683,7 +689,7 @@ def _fused_epoch_body(
         # proven progress — don't charge the upcoming eval/save to the
         # compute window's stall budget
         watchdog.beat()
-        dt = time.time() - t0
+        dt = time.monotonic() - t0
         fps = args.steps_per_epoch * samples_per_iter / dt
         mean_ret = (
             metrics["episode_return_sum"] / metrics["episodes"]
@@ -700,7 +706,9 @@ def _fused_epoch_body(
             # per epoch; any mismatch across ranks means the psum'd update
             # broke lockstep (costs a params device_get — debug only)
             leaves = jax.tree_util.tree_leaves(
-                jax.device_get(state.train.params)
+                # epoch-boundary debug fetch, explicitly opt-in and costed
+                # in the comment above — not a per-step sync
+                jax.device_get(state.train.params)  # ba3clint: disable=J1
             )
             logger.info(
                 "param_digest %s",
@@ -755,7 +763,9 @@ def _fused_epoch_body(
             metrics["loss"],
             metrics["entropy"],
         )
-        ckpt.save(jax.device_get(state.train), int(state.train.step))
+        # epoch-boundary checkpoint: the fetch is the save's payload, once
+        # per epoch — not a per-step sync
+        ckpt.save(jax.device_get(state.train), int(state.train.step))  # ba3clint: disable=J1
         # keep-best on GREEDY EVAL (not training-policy returns): the
         # reference's MaxSaver tracked the Evaluator's number
         if np.isfinite(eval_mean) and eval_mean > best:

@@ -30,7 +30,12 @@ from distributed_ba3c_tpu.config import BA3CConfig
 from distributed_ba3c_tpu.models.a3c import BA3CNet
 from distributed_ba3c_tpu.ops.gradproc import grad_summaries, inject_learning_rate
 from distributed_ba3c_tpu.ops.loss import a3c_loss
-from distributed_ba3c_tpu.parallel.mesh import DATA_AXIS
+from distributed_ba3c_tpu.parallel.mesh import (
+    DATA_AXIS,
+    axis_size,
+    grad_allreduce,
+    shard_map,
+)
 
 
 class TrainState(struct.PyTreeNode):
@@ -95,8 +100,10 @@ def _local_step(
     # Under shard_map's check_vma=True semantics the transpose auto-inserts the
     # psum for the replicated params (grads arrive device-invariant, SUMMED over
     # the data axis); dividing by the axis size yields the global batch mean.
-    # (An explicit lax.pmean here would double-count by the axis size.)
-    n_data = jax.lax.axis_size(DATA_AXIS)
+    # (An explicit lax.pmean here would double-count by the axis size;
+    # grad_allreduce is identity there and psums only on old-jax check_rep=False.)
+    grads = grad_allreduce(grads, DATA_AXIS)
+    n_data = axis_size(DATA_AXIS)
     grads = jax.tree_util.tree_map(lambda g: g / n_data, grads)
 
     opt_state = inject_learning_rate(state.opt_state, learning_rate)
@@ -135,7 +142,7 @@ def make_train_step(
     batch_spec = P(DATA_AXIS)
 
     body = functools.partial(_local_step, model, optimizer, cfg)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=(replicated, batch_spec, replicated, replicated),

@@ -31,7 +31,12 @@ from distributed_ba3c_tpu.config import BA3CConfig
 from distributed_ba3c_tpu.models.a3c import BA3CNet
 from distributed_ba3c_tpu.ops.gradproc import grad_summaries, inject_learning_rate
 from distributed_ba3c_tpu.ops.vtrace import vtrace_returns
-from distributed_ba3c_tpu.parallel.mesh import DATA_AXIS
+from distributed_ba3c_tpu.parallel.mesh import (
+    DATA_AXIS,
+    axis_size,
+    grad_allreduce,
+    shard_map,
+)
 from distributed_ba3c_tpu.parallel.train_step import TrainState
 
 
@@ -90,7 +95,8 @@ def _local_step(
         return total, aux
 
     (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
-    n_data = jax.lax.axis_size(DATA_AXIS)
+    grads = grad_allreduce(grads, DATA_AXIS)
+    n_data = axis_size(DATA_AXIS)
     grads = jax.tree_util.tree_map(lambda g: g / n_data, grads)
 
     opt_state = inject_learning_rate(state.opt_state, learning_rate)
@@ -121,7 +127,7 @@ def make_vtrace_train_step(
         "bootstrap_state": P(DATA_AXIS),
     }
     body = functools.partial(_local_step, model, optimizer, cfg)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=(replicated, specs, replicated, replicated),

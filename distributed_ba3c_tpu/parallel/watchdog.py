@@ -165,7 +165,10 @@ class LockstepWatchdog:
 
     def __enter__(self) -> "LockstepWatchdog":
         if self.timeout_s > 0:
-            self._thread = threading.Thread(
+            # deliberately a bare thread: its loop IS an Event.wait on
+            # self._stop (set in __exit__, joined below) — a StoppableThread
+            # would just duplicate that event
+            self._thread = threading.Thread(  # ba3clint: disable=A1
                 target=self._watch, name="lockstep-watchdog", daemon=True
             )
             self._last = time.monotonic()

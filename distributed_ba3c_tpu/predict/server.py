@@ -27,7 +27,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distributed_ba3c_tpu.utils.concurrency import StoppableThread
+from distributed_ba3c_tpu.utils.concurrency import (
+    StoppableThread,
+    queue_put_stoppable,
+)
 
 
 def _next_pow2(n: int) -> int:
@@ -66,6 +69,7 @@ class BatchedPredictor:
         self._key = jax.random.PRNGKey(seed)
         self._key_lock = threading.Lock()
         self._greedy = greedy
+        self._stop_evt = threading.Event()
 
         def fwd_sample(params, states, key):
             out = model.apply({"params": params}, states)
@@ -118,6 +122,7 @@ class BatchedPredictor:
             b *= 2
 
     def stop(self) -> None:
+        self._stop_evt.set()
         for t in self.threads:
             t.stop()
 
@@ -136,8 +141,10 @@ class BatchedPredictor:
         self, state: np.ndarray, callback: Callable[[int, float, float], None]
     ) -> None:
         """Queue one state; ``callback(action, value, logp)`` fires when
-        served — logp is log mu(action|state) under the sampling policy."""
-        self._queue.put((state, callback))
+        served — logp is log mu(action|state) under the sampling policy.
+        Tasks arriving after ``stop()`` (or while stopping with a full
+        queue) are dropped — their simulators are being torn down too."""
+        queue_put_stoppable(self._queue, (state, callback), self._stop_evt)
 
     def predict_batch(
         self, states: np.ndarray

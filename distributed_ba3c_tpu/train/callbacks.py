@@ -363,7 +363,7 @@ class StatPrinter(Callback):
         self._epoch_steps = 0
 
     def before_train(self):
-        self._epoch_t0 = time.time()
+        self._epoch_t0 = time.monotonic()
 
     def trigger_step(self, metrics):
         self._epoch_steps += 1
@@ -376,7 +376,7 @@ class StatPrinter(Callback):
     def trigger_epoch(self):
         tr = self.trainer
         holder = tr.stat_holder
-        dt = time.time() - self._epoch_t0 if self._epoch_t0 else 0.0
+        dt = time.monotonic() - self._epoch_t0 if self._epoch_t0 else 0.0
         samples = self._epoch_steps * tr.batch_size
         fps = samples / dt if dt > 0 else 0.0
         holder.add_stat("global_step", tr.global_step)
@@ -404,7 +404,7 @@ class StatPrinter(Callback):
         )
         self._counters = {}
         self._epoch_steps = 0
-        self._epoch_t0 = time.time()
+        self._epoch_t0 = time.monotonic()
 
 
 class ModelSaver(Callback):

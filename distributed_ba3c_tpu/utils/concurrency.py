@@ -4,7 +4,11 @@ Reference equivalent: ``tensorpack/utils/concurrency.py`` —
 ``ensure_proc_terminate``, ``StoppableThread``, ``LoopThread``, SIGINT masking
 in children (SURVEY.md §2.8 #26). Concurrency safety here, as in the
 reference, is by construction: message passing between processes, queues
-between threads, no shared mutable state.
+between threads, no shared mutable state. That convention is no longer just
+this docstring — ``python -m tools.ba3clint`` enforces it statically (bare
+threads, blocking queue ops, wall-clock timeouts; see
+docs/static_analysis.md) and ``utils/sanitizer.py`` (BA3C_SANITIZE=1) spot
+checks it at runtime in tests.
 """
 
 from __future__ import annotations
@@ -17,6 +21,36 @@ import threading
 import weakref
 from contextlib import contextmanager
 from typing import Callable, Iterable, Optional, Union
+
+
+def queue_put_stoppable(
+    q: queue.Queue, obj, stop_evt: threading.Event, timeout: float = 0.5
+) -> bool:
+    """Put, retrying until success or ``stop_evt``; returns False if stopped.
+
+    The ONE sanctioned way to put on a bounded actor-plane queue: bounded
+    waits that re-check the stop flag, so backpressure can never wedge
+    shutdown (ba3clint rule A2).
+    """
+    while not stop_evt.is_set():
+        try:
+            q.put(obj, timeout=timeout)
+            return True
+        except queue.Full:
+            pass
+    return False
+
+
+def queue_get_stoppable(
+    q: queue.Queue, stop_evt: threading.Event, timeout: float = 0.5
+):
+    """Get, retrying until success or ``stop_evt``; returns None if stopped."""
+    while not stop_evt.is_set():
+        try:
+            return q.get(timeout=timeout)
+        except queue.Empty:
+            pass
+    return None
 
 
 class StoppableThread(threading.Thread):
@@ -34,22 +68,11 @@ class StoppableThread(threading.Thread):
 
     def queue_put_stoppable(self, q: queue.Queue, obj, timeout: float = 0.5) -> bool:
         """Put, retrying until success or stop(); returns False if stopped."""
-        while not self.stopped():
-            try:
-                q.put(obj, timeout=timeout)
-                return True
-            except queue.Full:
-                pass
-        return False
+        return queue_put_stoppable(q, obj, self._stop_evt, timeout)
 
     def queue_get_stoppable(self, q: queue.Queue, timeout: float = 0.5):
         """Get, retrying until success or stop(); returns None if stopped."""
-        while not self.stopped():
-            try:
-                return q.get(timeout=timeout)
-            except queue.Empty:
-                pass
-        return None
+        return queue_get_stoppable(q, self._stop_evt, timeout)
 
 
 class LoopThread(StoppableThread):

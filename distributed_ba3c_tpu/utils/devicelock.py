@@ -32,7 +32,6 @@ from __future__ import annotations
 
 import errno
 import fcntl
-import functools
 import json
 import os
 import sys
@@ -45,8 +44,11 @@ MODES = ("wait", "fail", "off")
 
 # diagnostics go to STDERR: bench.py and the eval scripts print exactly one
 # JSON line on stdout for machine consumption — a "[tpu-lock] waiting" line
-# there would corrupt the contract
-_stderr_print = functools.partial(print, file=sys.stderr, flush=True)
+# there would corrupt the contract. sys.stderr is resolved at CALL time —
+# a functools.partial bound the import-time stream and silently wrote to a
+# stale object under any later redirection (pytest capture, daemonization).
+def _stderr_print(*args, **kwargs) -> None:
+    print(*args, file=sys.stderr, flush=True, **kwargs)
 
 
 def lock_path() -> str:

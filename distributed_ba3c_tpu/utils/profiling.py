@@ -25,11 +25,11 @@ def timed_operation(msg: str, log_start: bool = False):
     """Log the wall-clock duration of a block (reference ``timed_operation``)."""
     if log_start:
         logger.info("start %s ...", msg)
-    t0 = time.time()
+    t0 = time.monotonic()
     try:
         yield
     finally:
-        logger.info("%s finished, time:%.4f sec.", msg, time.time() - t0)
+        logger.info("%s finished, time:%.4f sec.", msg, time.monotonic() - t0)
 
 
 def start_server(port: int) -> None:

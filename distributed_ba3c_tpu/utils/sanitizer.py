@@ -1,0 +1,214 @@
+"""Opt-in runtime race sanitizer for the actor plane (``BA3C_SANITIZE=1``).
+
+ba3clint (tools/ba3clint) checks the actor plane's conventions *lexically*;
+two of them can only be fully verified at runtime, so this module makes them
+observable:
+
+1. **Client-table ownership** — the master's ``clients`` table is
+   structurally mutated (entries created/replaced/deleted) only by the
+   thread that owns it (the master's receive loop, which calls
+   :func:`claim_owner` at startup). A predictor callback resurrecting a
+   pruned client via ``defaultdict.__missing__`` is exactly the cross-thread
+   structural write this catches.
+2. **Single-consumer queues** — each plane queue (``send_queue``, the train
+   queue) is drained by exactly one thread; a second consumer means two
+   components think they own the hand-off side.
+
+On violation the sanitizer records a finding and raises
+:class:`SanitizerError` immediately (fail loudly); tests additionally assert
+``findings() == []`` at teardown so a swallowed exception still fails the
+run.
+
+Zero overhead when disabled: the ``wrap_*`` helpers return plain objects
+unless ``BA3C_SANITIZE`` is set to a truthy value, and :func:`claim_owner`
+is a no-op on unwrapped objects. The env var is read at *wrap time* so
+tests can flip it per-test with ``monkeypatch.setenv``.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as _queue_mod
+import threading
+from collections import defaultdict
+from typing import Callable, List, Optional
+
+
+class SanitizerError(AssertionError):
+    """A machine-checked actor-plane invariant was violated."""
+
+
+_findings: List[str] = []
+_findings_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    return os.environ.get("BA3C_SANITIZE", "") not in ("", "0")
+
+
+def findings() -> List[str]:
+    with _findings_lock:
+        return list(_findings)
+
+
+def reset() -> None:
+    with _findings_lock:
+        _findings.clear()
+
+
+def _report(msg: str) -> None:
+    with _findings_lock:
+        _findings.append(msg)
+    raise SanitizerError(msg)
+
+
+class SanitizedClientTable(dict):
+    """``defaultdict``-alike that restricts structural writes to one thread.
+
+    Reads (``[]`` on an existing key, ``items()``, ``len()``) are allowed
+    from any thread — the per-client *contents* are protocol-serialized and
+    checked elsewhere; what must be single-threaded is the table's shape.
+    """
+
+    def __init__(self, default_factory: Callable[[], object], name: str):
+        super().__init__()
+        self._factory = default_factory
+        self._name = name
+        self._owner: Optional[threading.Thread] = None
+
+    def claim_owner(self) -> None:
+        """Declare the calling thread the structural owner (master loop)."""
+        self._owner = threading.current_thread()
+
+    def _check(self, op: str, key) -> None:
+        owner = self._owner
+        if owner is None:
+            return  # unclaimed: setup-phase mutations are unrestricted
+        t = threading.current_thread()
+        if t is not owner:
+            _report(
+                f"{self._name}: structural {op} of {key!r} from thread "
+                f"{t.name!r} but the table is owned by {owner.name!r} — "
+                "cross-thread mutation without ownership transfer"
+            )
+
+    def __missing__(self, key):
+        self._check("create", key)
+        value = self._factory()
+        dict.__setitem__(self, key, value)
+        return value
+
+    def __setitem__(self, key, value) -> None:
+        self._check("set", key)
+        dict.__setitem__(self, key, value)
+
+    def __delitem__(self, key) -> None:
+        self._check("delete", key)
+        dict.__delitem__(self, key)
+
+    def pop(self, key, *default):
+        self._check("pop", key)
+        return dict.pop(self, key, *default)
+
+    def popitem(self):
+        self._check("popitem", "*")
+        return dict.popitem(self)
+
+    def setdefault(self, key, default=None):
+        if key not in self:
+            self._check("create", key)
+        return dict.setdefault(self, key, default)
+
+    def update(self, *args, **kwargs):
+        self._check("update", "*")
+        dict.update(self, *args, **kwargs)
+
+    def clear(self) -> None:
+        self._check("clear", "*")
+        dict.clear(self)
+
+
+class SanitizedQueue:
+    """Proxy around a ``queue.Queue`` asserting the single-consumer contract.
+
+    A proxy (not a subclass copy) so the wrapped queue's storage is shared
+    with any pre-existing references the caller holds. The consumer slot
+    re-arms when the recorded consumer thread has exited, so sequential
+    owners (test teardown → next test) are fine; *concurrent* second
+    consumers are findings.
+    """
+
+    def __init__(self, q: _queue_mod.Queue, name: str):
+        self._q = q
+        self._name = name
+        self._consumer: Optional[threading.Thread] = None
+        self._consumer_lock = threading.Lock()
+
+    def _check_consumer(self) -> None:
+        t = threading.current_thread()
+        with self._consumer_lock:
+            c = self._consumer
+            if c is None or c is t or not c.is_alive():
+                self._consumer = t
+                return
+        _report(
+            f"{self._name}: get() from thread {t.name!r} but "
+            f"{c.name!r} is already the live consumer — a plane queue "
+            "must have exactly one drain thread"
+        )
+
+    # -- consumer side (checked) ------------------------------------------
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        self._check_consumer()
+        return self._q.get(block=block, timeout=timeout)
+
+    def get_nowait(self):
+        self._check_consumer()
+        return self._q.get_nowait()
+
+    # -- producer side / passthrough --------------------------------------
+    def put(self, item, block: bool = True, timeout: Optional[float] = None):
+        return self._q.put(item, block=block, timeout=timeout)
+
+    def put_nowait(self, item):
+        return self._q.put_nowait(item)
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    def full(self) -> bool:
+        return self._q.full()
+
+    def task_done(self) -> None:
+        self._q.task_done()
+
+    def join(self) -> None:
+        self._q.join()
+
+    @property
+    def maxsize(self) -> int:
+        return self._q.maxsize
+
+
+def wrap_client_table(default_factory: Callable[[], object], name: str):
+    """A client table: sanitized when enabled, plain defaultdict otherwise."""
+    if not enabled():
+        return defaultdict(default_factory)
+    return SanitizedClientTable(default_factory, name)
+
+
+def wrap_queue(q: _queue_mod.Queue, name: str):
+    """Wrap an actor-plane queue with the single-consumer check (when on)."""
+    if not enabled():
+        return q
+    return SanitizedQueue(q, name)
+
+
+def claim_owner(obj) -> None:
+    """Record the calling thread as ``obj``'s owner (no-op if unwrapped)."""
+    claim = getattr(obj, "claim_owner", None)
+    if callable(claim):
+        claim()

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Pre-commit entry point: the repo's static gates, fast enough to run on
+# every commit (no tests, no device — pure host-side analysis).
+#
+#   ./scripts/check.sh
+#
+# Gate 1: ba3clint — the repo-specific AST lint suite (rule catalog in
+#         docs/static_analysis.md). Exit 1 on any unsuppressed finding.
+# Gate 2: compileall — every shipped .py must at least byte-compile.
+#
+# CI runs exactly this script (.github/workflows/ci.yml `lint` job), so a
+# clean local run means a clean CI lint job.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== ba3clint =="
+python -m tools.ba3clint distributed_ba3c_tpu scripts train.py bench.py
+
+echo "== compileall =="
+python -m compileall -q distributed_ba3c_tpu tools scripts tests train.py bench.py
+
+echo "check.sh: all gates passed"

@@ -139,7 +139,8 @@ def main():
             # warm both
             es, st, k, er, S, A, R = rollout(params, env_state, stack, key, ep_ret)
             train2 = learner(train, S, A, R, cfg.entropy_beta, cfg.learning_rate)
-            jax.block_until_ready(train2)
+            # warmup sync: a profiler must force the compile before timing
+            jax.block_until_ready(train2)  # ba3clint: disable=J1
 
             iters = 10
             t0 = time.perf_counter()
@@ -148,7 +149,8 @@ def main():
             for _ in range(iters):
                 es, st, k, er, S, A, R = rollout(tr.params, es, st, k, er)
                 tr = learner(tr, S, A, R, cfg.entropy_beta, cfg.learning_rate)
-            jax.block_until_ready(tr)
+            # measurement fence: the timed region must include execution
+            jax.block_until_ready(tr)  # ba3clint: disable=J1
             dt = (time.perf_counter() - t0) / iters
             print(
                 f"split n_chunks={n_chunks}: {dt*1e3:7.2f}ms/step "

@@ -1,0 +1,6 @@
+"""Lint-rule fixtures: parsed by ba3clint in tests, never imported/executed.
+
+Each rule R has ``r*_flagged.py`` (>=1 violation of R) and ``r*_clean.py``
+(idiomatic code the rule must NOT fire on). ``suppressed.py`` holds real
+violations silenced by inline ``# ba3clint: disable=...`` comments.
+"""

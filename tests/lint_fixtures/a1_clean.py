@@ -1,0 +1,22 @@
+"""A1 clean: stoppable wrappers and managed processes."""
+from distributed_ba3c_tpu.utils.concurrency import (
+    LoopThread,
+    StoppableThread,
+    ensure_proc_terminate,
+    start_proc_mask_signal,
+)
+
+
+def start_worker(fn):
+    t = StoppableThread(target=fn, daemon=True)
+    t.start()
+    return t
+
+
+def start_pump(fn):
+    return LoopThread(fn)
+
+
+def start_children(procs):
+    ensure_proc_terminate(procs)
+    start_proc_mask_signal(procs)

@@ -1,0 +1,15 @@
+"""A1 flagged: bare Thread/Process instantiation."""
+import multiprocessing as mp
+import threading
+
+
+def start_worker(fn):
+    t = threading.Thread(target=fn, daemon=True)  # A1: no stop flag
+    t.start()
+    return t
+
+
+def start_child(fn):
+    p = mp.Process(target=fn)  # A1: unmanaged process
+    p.start()
+    return p

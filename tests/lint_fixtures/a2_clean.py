@@ -1,0 +1,25 @@
+"""A2 clean: timeouts + stop-flag rechecks, _nowait variants, dict.get."""
+import queue
+
+
+class Pump:
+    def __init__(self, in_queue, out_queue, stop_evt):
+        self.in_queue = in_queue
+        self.out_queue = out_queue
+        self.stop_evt = stop_evt
+
+    def drain(self):
+        while not self.stop_evt.is_set():
+            try:
+                item = self.in_queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self.out_queue.put(item, timeout=0.2)
+            except queue.Full:
+                pass
+
+    def best_effort(self, item, config):
+        self.out_queue.put_nowait(item)
+        got = self.in_queue.get_nowait()
+        return got, config.get("mode")  # dict.get, not a queue op

@@ -1,0 +1,12 @@
+"""A2 flagged: blocking queue ops with no timeout."""
+
+
+class Pump:
+    def __init__(self, in_queue, out_queue):
+        self.in_queue = in_queue
+        self.out_queue = out_queue
+
+    def drain_forever(self):
+        while True:
+            item = self.in_queue.get()  # A2: never re-checks the stop flag
+            self.out_queue.put(item)  # A2: wedges when the consumer dies

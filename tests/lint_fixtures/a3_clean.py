@@ -1,0 +1,21 @@
+"""A3 clean: closures only read; mutation stays on the master thread."""
+
+
+class Master:
+    def __init__(self, predictor, send_queue):
+        self.clients = {}
+        self.predictor = predictor
+        self.send_queue = send_queue
+
+    def on_state(self, state, ident):
+        def cb(action, value):
+            # hand the result back to the master thread via the queue
+            self.send_queue.put((ident, state, action, value), timeout=0.5)
+
+        self.predictor.put_task(state, cb)
+
+    def on_result(self, ident, state, action, value):
+        # master thread: the single owner of client state
+        client = self.clients[ident]
+        client.memory.append((state, action, value))
+        client.score += value

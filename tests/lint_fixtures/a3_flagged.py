@@ -1,0 +1,16 @@
+"""A3 flagged: client-table state mutated from closures."""
+
+
+class Master:
+    def __init__(self, predictor):
+        self.clients = {}
+        self.predictor = predictor
+
+    def on_state(self, state, ident):
+        def cb(action, value):
+            client = self.clients[ident]
+            client.memory.append((state, action, value))  # A3
+            client.score += value  # A3
+            self.clients[ident] = client  # A3: structural write
+
+        self.predictor.put_task(state, cb)

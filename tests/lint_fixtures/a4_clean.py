@@ -1,0 +1,20 @@
+"""A4 clean: monotonic for intervals; wall clock only as exported timestamp."""
+import json
+import time
+
+
+class Heartbeats:
+    def __init__(self, timeout):
+        self.timeout = timeout
+        self.last_seen = time.monotonic()
+
+    def beat(self):
+        self.last_seen = time.monotonic()
+
+    def expired(self):
+        return time.monotonic() - self.last_seen > self.timeout
+
+
+def log_event(channel, value):
+    # a timestamp that leaves the process IS wall-clock business
+    return json.dumps({"channel": channel, "y": value, "ts": time.time()}) + "\n"

@@ -1,0 +1,20 @@
+"""A4 flagged: wall-clock time in interval/timeout arithmetic."""
+import time
+
+
+class Heartbeats:
+    def __init__(self, timeout):
+        self.timeout = timeout
+        self.last_seen = time.time()  # A4: suspicious target name
+
+    def beat(self):
+        self.last_seen = time.time()  # A4
+
+    def expired(self):
+        return time.time() - self.last_seen > self.timeout  # A4: arithmetic
+
+
+def wait_until(deadline_s):
+    deadline = time.time() + deadline_s  # A4
+    while time.time() < deadline:  # A4: comparison
+        pass

@@ -1,0 +1,36 @@
+"""J1 flagged: host syncs inside loops / jitted scopes."""
+import jax
+import numpy as np
+
+
+def step_fn(state, batch):
+    return state
+
+
+jitted = jax.jit(step_fn)
+
+
+def train_loop(state, batches):
+    for batch in batches:
+        state = jitted(state, batch)
+        loss = jax.device_get(state)  # J1: host sync every iteration
+        print(loss)
+    return state
+
+
+def wait_loop(arrays):
+    for a in arrays:
+        a.block_until_ready()  # J1: sync in loop
+
+
+def traced(x):
+    return np.asarray(x) + 1  # J1: np inside a jitted function
+
+
+traced_jit = jax.jit(traced)
+
+
+def cast_loop(state, batches):
+    for batch in batches:
+        v = float(jitted(state, batch))  # J1: host cast of jitted result
+        print(v)

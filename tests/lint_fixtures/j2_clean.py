@@ -1,0 +1,11 @@
+"""J2 clean: jit constructed once, called in the loop."""
+import jax
+
+
+def make_step(fn):
+    return jax.jit(fn)  # constructed once per factory call
+
+
+def sweep(fn, xs):
+    jitted = jax.jit(fn)  # hoisted out of the loop
+    return [jitted(x) for x in xs]

@@ -1,0 +1,17 @@
+"""J2 flagged: jax.jit constructed inside loop bodies."""
+import jax
+
+
+def sweep(fns, x):
+    outs = []
+    for fn in fns:
+        jitted = jax.jit(fn)  # J2: fresh cache + retrace every iteration
+        outs.append(jitted(x))
+    return outs
+
+
+def poll(fn, x):
+    while True:
+        y = jax.jit(fn)(x)  # J2
+        if y is not None:
+            return y

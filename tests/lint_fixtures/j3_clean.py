@@ -1,0 +1,15 @@
+"""J3 clean: arrays/variables passed to the jitted callable."""
+import jax
+import jax.numpy as jnp
+
+
+def fwd(params, batch):
+    return batch
+
+
+jitted = jax.jit(fwd)
+
+
+def serve(params, states):
+    batch = jnp.stack(states)
+    return jitted(params, batch)  # a name, built outside the call

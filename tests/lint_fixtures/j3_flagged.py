@@ -1,0 +1,14 @@
+"""J3 flagged: literal dict/list/str args to a jitted callable."""
+import jax
+
+
+def fwd(params, batch, mode):
+    return batch
+
+
+jitted = jax.jit(fwd)
+
+
+def serve(params, x):
+    out = jitted(params, {"state": x, "scale": 0.5}, "train")  # J3 x2
+    return jitted(params, [1, 2, 3], mode=None)  # J3: list literal

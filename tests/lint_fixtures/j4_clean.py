@@ -1,0 +1,17 @@
+"""J4 clean: every consumption goes through split/fold_in."""
+import jax
+
+
+def sample_twice(shape):
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    return jax.random.normal(k1, shape), jax.random.uniform(k2, shape)
+
+
+def sample_loop(shapes):
+    key = jax.random.PRNGKey(1)
+    outs = []
+    for i, s in enumerate(shapes):
+        sub = jax.random.fold_in(key, i)
+        outs.append(jax.random.normal(sub, s))
+    return outs

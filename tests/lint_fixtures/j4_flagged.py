@@ -1,0 +1,17 @@
+"""J4 flagged: PRNGKey consumed repeatedly without split."""
+import jax
+
+
+def sample_twice(shape):
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)  # J4: identical randomness
+    return a, b
+
+
+def sample_loop(shapes):
+    key = jax.random.PRNGKey(1)
+    outs = []
+    for s in shapes:
+        outs.append(jax.random.normal(key, s))  # J4: same draw every iter
+    return outs

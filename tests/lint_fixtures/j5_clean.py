@@ -1,0 +1,24 @@
+"""J5 clean: the donated name is rebound by the call (the intended idiom)."""
+import jax
+import jax.numpy as jnp
+
+
+def train_step(state, batch):
+    return state
+
+
+jitted = jax.jit(train_step, donate_argnums=(0,))
+
+
+def run(state, batches, predictor):
+    for batch in batches:
+        state = jitted(state, batch)  # rebinds: old buffer never read again
+    predictor.update(state)
+    return state
+
+
+def publish(state, batch, predictor):
+    params = jnp.copy(state)  # copy BEFORE donating
+    state = jitted(state, batch)
+    predictor.update(params)
+    return state

@@ -1,0 +1,15 @@
+"""J5 flagged: donated buffer read after the donating call."""
+import jax
+
+
+def train_step(state, batch):
+    return state
+
+
+jitted = jax.jit(train_step, donate_argnums=(0,))
+
+
+def run(state, batch, predictor):
+    new_state = jitted(state, batch)
+    predictor.update(state)  # J5: `state` was donated — buffer is gone
+    return new_state

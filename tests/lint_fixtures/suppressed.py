@@ -1,0 +1,24 @@
+"""Real violations silenced by inline suppressions (engine test fixture)."""
+import threading
+import time
+
+
+def start_watcher(fn):
+    # event-wait watcher with externally managed lifetime (justification!)
+    t = threading.Thread(target=fn, daemon=True)  # ba3clint: disable=A1
+    t.start()
+    return t
+
+
+def drain(q):
+    while True:
+        # the producer is the OS (signalfd): it cannot die before us
+        # ba3clint: disable=A2
+        item = q.get()
+        if item is None:
+            return
+
+
+def stamp():
+    started = time.time() - 0.0  # ba3clint: disable=all
+    return started

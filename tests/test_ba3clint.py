@@ -1,0 +1,165 @@
+"""tools/ba3clint: per-rule fixtures, suppression semantics, CLI contract.
+
+Every rule must (a) fire on its ``*_flagged.py`` fixture and (b) stay quiet
+on its ``*_clean.py`` fixture — the clean fixtures encode the idioms the
+real codebase uses, so a rule regression that would spam the repo fails
+here first. The CLI tests pin the exit-status contract CI gates on.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.ba3clint import all_rules, lint_file, lint_paths
+from tools.ba3clint.engine import suppressions
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RULE_IDS = ["J1", "J2", "J3", "J4", "J5", "A1", "A2", "A3", "A4"]
+
+
+def _fixture(name):
+    return os.path.join(FIXTURES, name)
+
+
+def _findings(name, rule_id=None):
+    out = lint_file(_fixture(name), all_rules())
+    if rule_id is not None:
+        out = [f for f in out if f.rule == rule_id]
+    return out
+
+
+def test_rule_registry_complete():
+    assert [r.id for r in all_rules()] == RULE_IDS
+    for r in all_rules():
+        assert r.name and r.summary and r.__doc__
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_flagged_fixture_fires(rule_id):
+    name = f"{rule_id.lower()}_flagged.py"
+    hits = _findings(name, rule_id)
+    assert hits, f"{rule_id} produced no findings on {name}"
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_clean_fixture_is_silent(rule_id):
+    name = f"{rule_id.lower()}_clean.py"
+    hits = _findings(name, rule_id)
+    assert not hits, f"{rule_id} false-positives on {name}: {hits}"
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_clean_fixtures_clean_under_every_rule(rule_id):
+    """A clean fixture must not trade one rule's silence for another's noise."""
+    hits = _findings(f"{rule_id.lower()}_clean.py")
+    assert not hits, hits
+
+
+def test_expected_flag_counts():
+    """Pin a few exact counts so rules don't silently widen or narrow."""
+    assert len(_findings("a4_flagged.py", "A4")) == 5
+    assert len(_findings("a3_flagged.py", "A3")) == 3
+    assert len(_findings("j3_flagged.py", "J3")) == 3
+    assert len(_findings("a2_flagged.py", "A2")) == 2
+
+
+def test_suppressions_silence_real_violations():
+    assert _findings("suppressed.py") == []
+    # ...and the suppression parser sees all three comment forms
+    with open(_fixture("suppressed.py")) as fh:
+        sup = suppressions(fh.read())
+    assert any("A1" in s for s in sup.values())
+    assert any("A2" in s for s in sup.values())
+    assert any("ALL" in s for s in sup.values())
+
+
+def test_standalone_comment_suppresses_next_line():
+    sup = suppressions("# ba3clint: disable=A2\nx = q.get()\n")
+    assert "A2" in sup.get(1, set()) and "A2" in sup.get(2, set())
+
+
+def test_syntax_error_is_reported_not_raised(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    out = lint_file(str(bad), all_rules())
+    assert [f.rule for f in out] == ["E001"]
+
+
+def test_submodule_import_does_not_shadow_package_resolution(tmp_path):
+    """`import jax.numpy` binds the name `jax`, not `jax.numpy` — J-rules
+    must still resolve jax.jit/jax.device_get in such files."""
+    f = tmp_path / "sub.py"
+    f.write_text(
+        "import jax.numpy\n"
+        "def run(fns, xs):\n"
+        "    for fn in fns:\n"
+        "        y = jax.jit(fn)(xs)\n"
+        "        print(jax.device_get(y))\n"
+    )
+    rules = {fi.rule for fi in lint_file(str(f), all_rules())}
+    assert {"J1", "J2"} <= rules, rules
+
+
+def test_missing_lint_path_fails_loudly(tmp_path):
+    """A mistyped gate target must error, not pass green over zero files."""
+    with pytest.raises(FileNotFoundError):
+        lint_paths([str(tmp_path / "no_such_dir")], all_rules())
+    r = _run_cli(str(tmp_path / "no_such_dir"))
+    assert r.returncode == 2
+    assert "does not exist" in r.stderr
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.ba3clint", *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+    )
+
+
+def test_cli_nonzero_on_flagged_fixture():
+    r = _run_cli(_fixture("a4_flagged.py"))
+    assert r.returncode == 1
+    assert "[A4]" in r.stdout
+
+
+def test_cli_zero_on_clean_fixture_and_list_rules():
+    r = _run_cli(_fixture("a4_clean.py"))
+    assert r.returncode == 0
+    assert "0 findings" in r.stdout
+    r = _run_cli("--list-rules")
+    assert r.returncode == 0
+    for rid in RULE_IDS:
+        assert rid in r.stdout
+
+
+def test_cli_json_output_and_select():
+    r = _run_cli("--format", "json", "--select", "A4", _fixture("a4_flagged.py"))
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    assert payload and all(f["rule"] == "A4" for f in payload)
+    assert {"path", "line", "col", "rule", "message"} <= set(payload[0])
+    r = _run_cli("--select", "NOPE", _fixture("a4_flagged.py"))
+    assert r.returncode == 2
+
+
+def test_repo_tree_is_lint_clean():
+    """The acceptance gate: the shipped tree has no unsuppressed findings."""
+    findings = lint_paths(
+        [
+            os.path.join(REPO_ROOT, "distributed_ba3c_tpu"),
+            os.path.join(REPO_ROOT, "scripts"),
+            os.path.join(REPO_ROOT, "train.py"),
+            os.path.join(REPO_ROOT, "bench.py"),
+        ],
+        all_rules(),
+    )
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in findings
+    )

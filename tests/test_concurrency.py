@@ -1,0 +1,106 @@
+"""Direct unit tests for utils/concurrency.py (previously only exercised
+indirectly through the actor plane): StoppableThread stop semantics, the
+stoppable queue helpers' return contracts, LoopThread shutdown, and the
+module-level helpers the masters/predictor use."""
+
+import queue
+import threading
+import time
+
+from distributed_ba3c_tpu.utils.concurrency import (
+    LoopThread,
+    StoppableThread,
+    queue_get_stoppable,
+    queue_put_stoppable,
+)
+
+
+def test_stoppable_thread_stop_flag():
+    t = StoppableThread()
+    assert not t.stopped()
+    t.stop()
+    assert t.stopped()
+    # stop() before start() is legal and idempotent
+    t.stop()
+    assert t.stopped()
+
+
+def test_stoppable_thread_run_until_stopped():
+    ticks = []
+
+    class T(StoppableThread):
+        def run(self):
+            while not self.stopped():
+                ticks.append(1)
+                time.sleep(0.001)
+
+    t = T(daemon=True)
+    t.start()
+    time.sleep(0.05)
+    t.stop()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert ticks, "thread never entered its loop"
+
+
+def test_queue_put_stoppable_success_and_stop():
+    q = queue.Queue(maxsize=1)
+    evt = threading.Event()
+    assert queue_put_stoppable(q, "a", evt, timeout=0.01) is True
+    assert q.get_nowait() == "a"
+    # full queue + stop mid-wait -> False, item NOT enqueued
+    q.put("blocker")
+    stopper = threading.Timer(0.05, evt.set)
+    stopper.start()
+    try:
+        assert queue_put_stoppable(q, "b", evt, timeout=0.01) is False
+    finally:
+        stopper.cancel()
+    assert q.get_nowait() == "blocker"
+    assert q.empty()
+    # already-stopped -> immediate False without touching the queue
+    assert queue_put_stoppable(q, "c", evt, timeout=0.01) is False
+    assert q.empty()
+
+
+def test_queue_get_stoppable_success_and_stop():
+    q = queue.Queue()
+    evt = threading.Event()
+    q.put("x")
+    assert queue_get_stoppable(q, evt, timeout=0.01) == "x"
+    # empty queue + stop mid-wait -> None
+    stopper = threading.Timer(0.05, evt.set)
+    stopper.start()
+    try:
+        assert queue_get_stoppable(q, evt, timeout=0.01) is None
+    finally:
+        stopper.cancel()
+    # already-stopped -> None even though an item is available (contract:
+    # stop wins; the caller is shutting down and must not consume)
+    q.put("y")
+    assert queue_get_stoppable(q, evt, timeout=0.01) is None
+    assert q.get_nowait() == "y"
+
+
+def test_thread_queue_helpers_use_own_stop_flag():
+    t = StoppableThread()
+    q = queue.Queue(maxsize=1)
+    assert t.queue_put_stoppable(q, 1, timeout=0.01) is True
+    assert t.queue_get_stoppable(q, timeout=0.01) == 1
+    t.stop()
+    assert t.queue_put_stoppable(q, 2, timeout=0.01) is False
+    assert t.queue_get_stoppable(q, timeout=0.01) is None
+
+
+def test_loop_thread_runs_func_and_stops():
+    calls = []
+    lt = LoopThread(lambda: (calls.append(1), time.sleep(0.001)))
+    lt.start()
+    time.sleep(0.05)
+    lt.stop()
+    lt.join(timeout=5)
+    assert not lt.is_alive()
+    assert len(calls) >= 2, "LoopThread should call func repeatedly"
+    n = len(calls)
+    time.sleep(0.02)
+    assert len(calls) == n, "LoopThread kept running after stop()+join()"

@@ -13,11 +13,13 @@ import os
 import pytest
 
 from distributed_ba3c_tpu.cli import main
+from distributed_ba3c_tpu.utils import sanitizer
 
 
 @pytest.mark.slow
 def test_cli_fake_env_learns(tmp_path):
     logdir = str(tmp_path / "log")
+    sanitizer.reset()  # fresh registry in case earlier tests recorded
     rc = main(
         [
             "--env",
@@ -50,3 +52,7 @@ def test_cli_fake_env_learns(tmp_path):
     assert final["mean_score"] >= 0.4, final
     # checkpoints written
     assert os.path.isdir(os.path.join(logdir, "checkpoints"))
+    # under BA3C_SANITIZE=1 (the CI sanitize job) the client table and the
+    # plane queues were wrapped for the whole run: no cross-thread
+    # structural writes, no second queue consumers (vacuous when disabled)
+    assert sanitizer.findings() == [], sanitizer.findings()

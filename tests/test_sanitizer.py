@@ -1,0 +1,177 @@
+"""utils/sanitizer.py: the BA3C_SANITIZE=1 actor-plane race sanitizer.
+
+Negative tests prove violations are caught (cross-thread structural table
+writes, second live queue consumer); the integration test proves the real
+actor plane produces NO findings under sanitization — the conventions the
+suppressed ba3clint-A3 sites claim actually hold at runtime.
+"""
+
+import functools
+import queue
+import threading
+import time
+
+import pytest
+
+from distributed_ba3c_tpu.utils import sanitizer
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    sanitizer.reset()
+    yield
+    sanitizer.reset()
+
+
+def test_disabled_by_default_returns_plain_objects(monkeypatch):
+    monkeypatch.delenv("BA3C_SANITIZE", raising=False)
+    table = sanitizer.wrap_client_table(dict, name="t")
+    assert not isinstance(table, sanitizer.SanitizedClientTable)
+    table["k"]  # defaultdict behavior preserved
+    q = queue.Queue()
+    assert sanitizer.wrap_queue(q, name="q") is q
+    sanitizer.claim_owner(q)  # no-op on unwrapped objects
+
+
+def test_client_table_cross_thread_structural_write_fails(monkeypatch):
+    monkeypatch.setenv("BA3C_SANITIZE", "1")
+    table = sanitizer.wrap_client_table(dict, name="master.clients")
+    assert isinstance(table, sanitizer.SanitizedClientTable)
+    table[b"pre-claim"]  # unclaimed: setup-phase creation is unrestricted
+
+    errors = []
+
+    def owner_loop(claimed):
+        table.claim_owner()
+        claimed.set()
+        table[b"owned"] = {}
+        del table[b"owned"]
+
+    claimed = threading.Event()
+    t = threading.Thread(target=owner_loop, args=(claimed,), daemon=True)
+    t.start()
+    assert claimed.wait(5)
+    t.join(timeout=5)
+
+    # reads from a foreign thread are fine
+    assert b"pre-claim" in table
+    # structural create from a foreign thread (the defaultdict-resurrection
+    # race) must fail loudly and be recorded
+    with pytest.raises(sanitizer.SanitizerError):
+        table[b"resurrected"]
+    with pytest.raises(sanitizer.SanitizerError):
+        del table[b"pre-claim"]
+    # every structural-mutation spelling is covered, not just []/del
+    with pytest.raises(sanitizer.SanitizerError):
+        table.setdefault(b"via-setdefault", {})
+    with pytest.raises(sanitizer.SanitizerError):
+        table.update({b"via-update": {}})
+    with pytest.raises(sanitizer.SanitizerError):
+        table.popitem()
+    assert b"via-setdefault" not in table and b"via-update" not in table
+    assert len(sanitizer.findings()) == 5
+    assert "cross-thread mutation" in sanitizer.findings()[0]
+
+
+def test_queue_second_live_consumer_fails(monkeypatch):
+    monkeypatch.setenv("BA3C_SANITIZE", "1")
+    inner = queue.Queue()
+    q = sanitizer.wrap_queue(inner, name="send_queue")
+    assert isinstance(q, sanitizer.SanitizedQueue)
+    assert q.maxsize == inner.maxsize
+
+    stop = threading.Event()
+
+    def consumer():
+        while not stop.is_set():
+            try:
+                q.get(timeout=0.05)
+            except queue.Empty:
+                pass
+
+    t = threading.Thread(target=consumer, daemon=True, name="drain")
+    t.start()
+    try:
+        q.put("item")  # producers are unrestricted
+        time.sleep(0.1)
+        with pytest.raises(sanitizer.SanitizerError):
+            q.get_nowait()  # main thread becomes a SECOND live consumer
+        assert sanitizer.findings()
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    # after the consumer thread exits, the slot re-arms: sequential
+    # ownership across tests is not a race
+    sanitizer.reset()
+    q.put("later")
+    assert q.get(timeout=1) == "later"
+    assert sanitizer.findings() == []
+
+
+def test_sanitized_actor_plane_has_no_findings(tmp_path, monkeypatch):
+    """The real ZMQ actor plane (simulator procs -> master -> predictor ->
+    train queue) runs clean under BA3C_SANITIZE=1: the client table is only
+    structurally mutated by the master loop and each queue has one drain
+    thread — the runtime half of the suppressed ba3clint-A3 justifications."""
+    monkeypatch.setenv("BA3C_SANITIZE", "1")
+    import jax
+    import numpy as np
+
+    from distributed_ba3c_tpu.actors.master import BA3CSimulatorMaster
+    from distributed_ba3c_tpu.actors.simulator import (
+        SimulatorProcess,
+        default_pipes,
+    )
+    from distributed_ba3c_tpu.config import BA3CConfig
+    from distributed_ba3c_tpu.envs.fake import build_fake_player
+    from distributed_ba3c_tpu.models.a3c import BA3CNet
+    from distributed_ba3c_tpu.predict.server import BatchedPredictor
+    from distributed_ba3c_tpu.utils.concurrency import ensure_proc_terminate
+
+    cfg = BA3CConfig(image_size=(16, 16), fc_units=16, num_actions=4)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, *cfg.state_shape), np.uint8)
+    )["params"]
+    predictor = BatchedPredictor(model, params, batch_size=4, num_threads=1)
+
+    c2s, s2c = f"ipc://{tmp_path}/c2s", f"ipc://{tmp_path}/s2c"
+    master = BA3CSimulatorMaster(
+        c2s, s2c, predictor, gamma=cfg.gamma,
+        local_time_max=cfg.local_time_max,
+    )
+    assert isinstance(master.clients, sanitizer.SanitizedClientTable)
+    assert isinstance(master.send_queue, sanitizer.SanitizedQueue)
+    assert isinstance(master.queue, sanitizer.SanitizedQueue)
+
+    build = functools.partial(
+        build_fake_player,
+        image_size=cfg.image_size,
+        frame_history=cfg.frame_history,
+        num_actions=cfg.num_actions,
+    )
+    procs = [SimulatorProcess(i, c2s, s2c, build) for i in range(2)]
+    ensure_proc_terminate(procs)
+    predictor.start()
+    master.start()
+    for p in procs:
+        p.start()
+    try:
+        got = 0
+        deadline = time.monotonic() + 120
+        while got < 32 and time.monotonic() < deadline:
+            try:
+                master.queue.get(timeout=5)
+                got += 1
+            except queue.Empty:
+                pass
+        assert got >= 32, "sanitized actor plane produced too few datapoints"
+    finally:
+        for p in procs:
+            p.terminate()
+        master.close()
+        predictor.stop()
+        predictor.join(timeout=5)
+        for p in procs:
+            p.join(timeout=5)
+    assert sanitizer.findings() == [], sanitizer.findings()

@@ -1,0 +1,40 @@
+"""ba3clint: repo-specific static analysis for the BA3C actor/learner stack.
+
+Usage:
+    python -m tools.ba3clint [paths...] [--format json] [--select A1,J2]
+    python -m tools.ba3clint --list-rules
+
+Two rule families (catalog: docs/static_analysis.md):
+
+* **J-series** — JAX hot-path hazards: host syncs in step loops or jitted
+  functions (J1), ``jax.jit`` built inside a loop (J2), non-static literal
+  args to jitted callables (J3), PRNGKey reuse without ``split`` (J4),
+  reading a donated buffer after the call (J5).
+* **A-series** — actor-plane concurrency conventions: bare threads (A1),
+  blocking queue ops without timeouts (A2), cross-thread client-state
+  mutation from closures (A3), wall-clock timeout arithmetic (A4).
+
+Per-line suppression: ``# ba3clint: disable=A2`` (comma-separate ids;
+``disable=all`` kills everything on the line). A standalone comment line
+suppresses the following line. Always pair a suppression with the reason it
+is safe — the suppression IS the documentation of the invariant.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.ba3clint.engine import (  # noqa: F401 (public API re-exports)
+    FileContext,
+    Finding,
+    Rule,
+    lint_file,
+    lint_paths,
+)
+
+
+def all_rules() -> List[Rule]:
+    from tools.ba3clint.rules_actor import ACTOR_RULES
+    from tools.ba3clint.rules_jax import JAX_RULES
+
+    return list(JAX_RULES) + list(ACTOR_RULES)

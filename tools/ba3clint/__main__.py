@@ -1,0 +1,78 @@
+"""CLI: ``python -m tools.ba3clint [paths...]``.
+
+Exit status: 0 = clean, 1 = findings, 2 = bad usage. CI gates on this
+(scripts/check.sh is the pre-commit entry point).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from tools.ba3clint import all_rules, lint_paths
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.ba3clint",
+        description="Repo-specific static analysis for the BA3C stack "
+        "(rule catalog: docs/static_analysis.md).",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["distributed_ba3c_tpu"],
+        help="files or directories to lint (default: distributed_ba3c_tpu)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("human", "json"),
+        default="human",
+        help="output format (default: human)",
+    )
+    parser.add_argument(
+        "--select",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalog and exit",
+    )
+    args = parser.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id:4s} {r.name:32s} {r.summary}")
+        return 0
+    if args.select:
+        wanted = {s.strip().upper() for s in args.select.split(",") if s.strip()}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print(
+                f"unknown rule id(s): {', '.join(sorted(unknown))}",
+                file=sys.stderr,
+            )
+            return 2
+        rules = [r for r in rules if r.id in wanted]
+
+    try:
+        findings = lint_paths(args.paths, rules)
+    except FileNotFoundError as e:
+        print(f"ba3clint: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f"{f.path}:{f.line}:{f.col + 1}: [{f.rule}] {f.message}")
+        n = len(findings)
+        print(f"ba3clint: {n} finding{'s' if n != 1 else ''}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,316 @@
+"""ba3clint engine: AST plumbing, suppression parsing, file walking.
+
+The framework is deliberately tiny: a rule is a class with an ``id`` and a
+``check(ctx)`` generator; the engine parses each file once, annotates parent
+links, precomputes module facts every rule needs (import aliases, names bound
+to ``jax.jit(...)`` results, donated-argument positions), runs every rule,
+and filters findings through per-line ``# ba3clint: disable=RULE`` comments.
+
+Heuristics over proofs: rules are tuned to this repo's idioms (see
+docs/static_analysis.md). When a rule is wrong about a specific line, the
+fix is an inline suppression WITH a justification comment — that is a
+feature: the invariant becomes visible at the use site.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``name``/``summary`` and ``check``."""
+
+    id: str = ""
+    name: str = ""
+    summary: str = ""
+
+    def check(self, ctx: "FileContext") -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------
+# suppression comments
+# --------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"#\s*ba3clint:\s*disable=([A-Za-z0-9_*,\s-]+)")
+
+
+def suppressions(source: str) -> Dict[int, Set[str]]:
+    """Map line number -> suppressed rule ids (``ALL`` disables every rule).
+
+    A trailing comment suppresses its own line; a standalone comment line
+    suppresses the following line as well (for statements too long to carry
+    the comment inline).
+    """
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {
+            r.strip().upper()
+            for r in m.group(1).replace(";", ",").split(",")
+            if r.strip()
+        }
+        out.setdefault(i, set()).update(rules)
+        if line.lstrip().startswith("#"):
+            out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+# --------------------------------------------------------------------------
+# AST helpers
+# --------------------------------------------------------------------------
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def annotate_parents(tree: ast.AST) -> ast.AST:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._ba3c_parent = node  # type: ignore[attr-defined]
+    return tree
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_ba3c_parent", None)
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        yield cur
+        cur = parent(cur)
+
+
+def enclosing_loop(node: ast.AST) -> Optional[ast.AST]:
+    """Nearest For/While ancestor within the same function scope, else None."""
+    for cur in ancestors(node):
+        if isinstance(cur, (ast.For, ast.While)):
+            return cur
+        if isinstance(cur, _SCOPE_NODES):
+            return None
+    return None
+
+
+def enclosing_statement(node: ast.AST) -> Optional[ast.stmt]:
+    if isinstance(node, ast.stmt):
+        return node
+    for cur in ancestors(node):
+        if isinstance(cur, ast.stmt):
+            return cur
+    return None
+
+
+def enclosing_functions(node: ast.AST) -> List[ast.AST]:
+    """All FunctionDef/AsyncFunctionDef ancestors, innermost first."""
+    return [
+        cur
+        for cur in ancestors(node)
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a pure Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def chain_root(node: ast.AST) -> ast.AST:
+    """Descend Attribute/Subscript/Call chains to the base expression."""
+    while True:
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        else:
+            return node
+
+
+# --------------------------------------------------------------------------
+# per-module facts shared by rules
+# --------------------------------------------------------------------------
+
+_JIT_NAMES = {"jax.jit", "jax.pjit", "jit", "pjit"}
+
+
+def _donate_positions(call: ast.Call) -> Tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                        out.append(el.value)
+                return tuple(out)
+    return ()
+
+
+class ModuleInfo:
+    """Import aliases + jit bookkeeping computed once per file."""
+
+    def __init__(self, tree: ast.AST):
+        #: local alias -> canonical dotted origin ("mp" -> "multiprocessing")
+        self.imports: Dict[str, str] = {}
+        #: dotted name of a variable/attr bound to a jax.jit(...) result
+        #: -> donated positional indices (possibly empty)
+        self.jitted: Dict[str, Tuple[int, ...]] = {}
+        #: plain function names passed to jax.jit / decorated with it —
+        #: their bodies are traced, so host ops inside them are hazards
+        self.jitted_fn_defs: Set[str] = set()
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.imports[a.asname] = a.name
+                    else:
+                        # `import jax.numpy` binds the NAME `jax`, not
+                        # `jax.numpy` — mapping the head to the full dotted
+                        # path would make jax.jit resolve as jax.numpy.jit
+                        head = a.name.split(".")[0]
+                        self.imports.setdefault(head, head)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.imports[a.asname or a.name] = f"{node.module}.{a.name}"
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and self._is_jit_call(node.value):
+                call = node.value
+                donate = _donate_positions(call)
+                for t in node.targets:
+                    nm = dotted_name(t)
+                    if nm:
+                        self.jitted[nm] = donate
+                if call.args:
+                    fn = dotted_name(call.args[0])
+                    if fn and "." not in fn:
+                        self.jitted_fn_defs.add(fn)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._is_jit_expr(dec):
+                        donate = (
+                            _donate_positions(dec)
+                            if isinstance(dec, ast.Call)
+                            else ()
+                        )
+                        self.jitted[node.name] = donate
+                        self.jitted_fn_defs.add(node.name)
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted name with the first segment resolved through imports:
+        ``_time.time`` -> ``time.time``, ``random.split`` -> ``jax.random.split``
+        (for ``from jax import random``)."""
+        nm = dotted_name(node)
+        if nm is None:
+            return None
+        head, _, rest = nm.partition(".")
+        origin = self.imports.get(head)
+        if origin is None:
+            return nm
+        return f"{origin}.{rest}" if rest else origin
+
+    def _is_jit_call(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Call) and self._is_jit_expr(node)
+
+    def _is_jit_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            node = node.func
+        return self.resolve(node) in _JIT_NAMES
+
+
+@dataclasses.dataclass
+class FileContext:
+    path: str
+    source: str
+    tree: ast.AST
+    info: ModuleInfo
+
+    def finding(self, rule: Rule, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            self.path,
+            getattr(node, "lineno", 1),
+            getattr(node, "col_offset", 0),
+            rule.id,
+            message,
+        )
+
+
+# --------------------------------------------------------------------------
+# running
+# --------------------------------------------------------------------------
+
+def iter_py_files(paths: Sequence[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        if not os.path.isdir(p):
+            # a gate must never pass green because its target was mistyped
+            # or renamed — "0 findings over 0 files" is not a clean bill
+            raise FileNotFoundError(f"lint path does not exist: {p!r}")
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs if not d.startswith(".") and d != "__pycache__"
+            )
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def lint_file(path: str, rules: Iterable[Rule]) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = annotate_parents(ast.parse(source, filename=path))
+    except SyntaxError as e:
+        return [
+            Finding(path, e.lineno or 1, (e.offset or 1) - 1, "E001",
+                    f"syntax error: {e.msg}")
+        ]
+    ctx = FileContext(path, source, tree, ModuleInfo(tree))
+    sup = suppressions(source)
+    out: List[Finding] = []
+    for rule in rules:
+        for f in rule.check(ctx):
+            disabled = sup.get(f.line, set())
+            if "ALL" in disabled or f.rule.upper() in disabled:
+                continue
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def lint_paths(paths: Sequence[str], rules: Iterable[Rule]) -> List[Finding]:
+    rules = list(rules)
+    out: List[Finding] = []
+    for path in iter_py_files(paths):
+        out.extend(lint_file(path, rules))
+    return out

@@ -1,0 +1,293 @@
+"""A-series rules: the actor plane's concurrency conventions, machine-checked.
+
+``utils/concurrency.py`` asserts "message passing only, no shared mutable
+state" in a docstring; these rules are that docstring as code. Rationale and
+worked examples live in docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set
+
+from tools.ba3clint.engine import (
+    FileContext,
+    Finding,
+    Rule,
+    ancestors,
+    chain_root,
+    dotted_name,
+    enclosing_functions,
+    enclosing_statement,
+)
+
+_THREAD_CTORS = {"threading.Thread"}
+_PROC_CTORS = {"multiprocessing.Process", "multiprocessing.context.Process"}
+
+
+class BareThreadRule(Rule):
+    """A1: bare ``threading.Thread``/``mp.Process`` instantiation.
+
+    A bare thread has no stop flag: shutdown can only kill it by exiting the
+    interpreter, and a leaked thread wedges later in-process jit dispatch
+    (the round-1 pytest deadlock). Use ``StoppableThread``/``LoopThread``
+    from ``utils.concurrency`` (threads) or a process that is registered
+    with ``ensure_proc_terminate`` — or suppress with the justification for
+    why this thread's lifetime is otherwise bounded.
+    """
+
+    id = "A1"
+    name = "bare-thread"
+    summary = "bare threading.Thread/mp.Process where a stoppable wrapper is required"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.info.resolve(node.func)
+            if resolved in _THREAD_CTORS:
+                yield ctx.finding(
+                    self, node,
+                    "bare threading.Thread has no stop flag — use "
+                    "StoppableThread/LoopThread (utils.concurrency) so "
+                    "shutdown can be observed",
+                )
+            elif resolved in _PROC_CTORS:
+                yield ctx.finding(
+                    self, node,
+                    "bare multiprocessing.Process — use a managed process "
+                    "(ensure_proc_terminate + start_proc_mask_signal)",
+                )
+
+
+_QUEUEISH_EXACT = {"q", "_q", "_out", "out_q", "outq", "in_q", "inq"}
+
+
+def _queueish(recv: ast.AST) -> bool:
+    if isinstance(recv, ast.Attribute):
+        last = recv.attr
+    elif isinstance(recv, ast.Name):
+        last = recv.id
+    else:
+        return False
+    low = last.lower()
+    return "queue" in low or low in _QUEUEISH_EXACT
+
+
+def _has_kw(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+def _nonblocking(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant):
+            if kw.value.value is False:
+                return True
+    return False
+
+
+class BlockingQueueOpRule(Rule):
+    """A2: blocking ``get()``/``put()`` without a timeout on a queue.
+
+    A get/put with no timeout blocks forever if the peer thread died — the
+    stop flag is never re-checked and shutdown wedges. Every queue op in the
+    actor plane must either carry a ``timeout=`` (and loop on the stop flag:
+    see ``queue_get_stoppable``/``queue_put_stoppable``) or be the
+    ``_nowait`` variant.
+    """
+
+    id = "A2"
+    name = "blocking-queue-op"
+    summary = "Queue.get()/put() with no timeout wedges shutdown"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            if fn.attr == "get":
+                # dict.get(key) takes positional args; Queue.get() does not
+                if node.args or _has_kw(node, "timeout") or _nonblocking(node):
+                    continue
+                if not _queueish(fn.value):
+                    continue
+                yield ctx.finding(
+                    self, node,
+                    "blocking Queue.get() with no timeout — pass timeout= "
+                    "and re-check the stop flag (queue_get_stoppable)",
+                )
+            elif fn.attr == "put":
+                if not node.args or _has_kw(node, "timeout") or _nonblocking(node):
+                    continue
+                if not _queueish(fn.value):
+                    continue
+                yield ctx.finding(
+                    self, node,
+                    "blocking Queue.put() with no timeout — pass timeout= "
+                    "and re-check the stop flag (queue_put_stoppable)",
+                )
+
+
+_MUTATORS = {
+    "append", "extend", "insert", "remove", "pop", "clear", "update",
+    "setdefault", "popitem", "add", "discard",
+}
+
+
+def _mentions_clients_subscript(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Subscript):
+            base = dotted_name(sub.value)
+            if base and (base == "clients" or base.endswith(".clients")):
+                return True
+    return False
+
+
+class CrossThreadClientMutationRule(Rule):
+    """A3: shared client-table state mutated from a closure.
+
+    Closures handed to the predictor (``put_task`` callbacks) run on a
+    predictor worker thread. Mutating per-client state (``client.memory``,
+    ``client.score``, the ``clients`` table itself) from there is only safe
+    when the wire protocol serializes it (the simulator is blocked awaiting
+    its action). That invariant lives outside the code — so every such
+    mutation must either go through a lock/queue or carry a suppression
+    whose justification states the serialization argument. The runtime
+    sanitizer (utils/sanitizer.py, BA3C_SANITIZE=1) checks the table half
+    of the claim in tests.
+    """
+
+    id = "A3"
+    name = "cross-thread-client-mutation"
+    summary = "client-table state mutated from a closure running on another thread"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not enclosing_functions(node):
+                continue  # only closures (nested defs) run on foreign threads
+            yield from self._check_closure(ctx, node)
+
+    def _check_closure(self, ctx: FileContext, fn: ast.AST) -> Iterator[Finding]:
+        tracked: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _mentions_clients_subscript(
+                node.value
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        tracked.add(t.id)
+
+        def is_shared(expr: ast.AST) -> bool:
+            root = chain_root(expr)
+            if isinstance(root, ast.Name) and root.id in tracked:
+                return True
+            return _mentions_clients_subscript(expr)
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _MUTATORS
+                    and is_shared(f.value)
+                ):
+                    yield ctx.finding(
+                        self, node,
+                        f".{f.attr}() on shared client state from a closure "
+                        "(runs on a predictor/worker thread) — needs a "
+                        "lock/queue handoff, or a suppression stating the "
+                        "protocol-serialization argument",
+                    )
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)) and is_shared(t):
+                        yield ctx.finding(
+                            self, node,
+                            "write to shared client state from a closure "
+                            "(runs on a predictor/worker thread) — needs a "
+                            "lock/queue handoff, or a suppression stating "
+                            "the protocol-serialization argument",
+                        )
+                        break
+
+
+_SUSPECT_TARGET_FRAGMENTS = (
+    "last", "t0", "deadline", "start", "seen", "now", "begin", "expire",
+    "elapsed", "heartbeat",
+)
+
+
+class WallClockArithRule(Rule):
+    """A4: ``time.time()`` used for interval/timeout arithmetic.
+
+    The wall clock jumps (NTP slew, suspend/resume, leap smearing); a
+    heartbeat or timeout computed from ``time.time()`` can mass-expire
+    every actor on a clock step (`actors/simulator.py` did exactly this for
+    ``last_seen``). Durations and deadlines must use ``time.monotonic()``;
+    ``time.time()`` is only for timestamps that leave the process (logs,
+    TensorBoard wall_time).
+    """
+
+    id = "A4"
+    name = "wall-clock-arith"
+    summary = "time.time() used for interval/timeout arithmetic instead of time.monotonic()"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.info.resolve(node.func) != "time.time":
+                continue
+            if self._in_arith(node) or self._assigned_to_suspect(node):
+                yield ctx.finding(
+                    self, node,
+                    "time.time() in interval/timeout arithmetic — the wall "
+                    "clock jumps; use time.monotonic()",
+                )
+
+    @staticmethod
+    def _in_arith(node: ast.AST) -> bool:
+        for cur in ancestors(node):
+            if isinstance(cur, (ast.BinOp, ast.Compare)):
+                return True
+            # the value was swallowed by a call or container before reaching
+            # any arithmetic (e.g. json.dumps({"ts": time.time()}) + "\n" is
+            # string concat on the *serialized* value, not clock arithmetic)
+            if isinstance(
+                cur, (ast.Call, ast.Dict, ast.List, ast.Set, ast.Tuple, ast.stmt)
+            ):
+                return False
+        return False
+
+    @staticmethod
+    def _assigned_to_suspect(node: ast.AST) -> bool:
+        stmt = enclosing_statement(node)
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for t in targets:
+            name = t.attr if isinstance(t, ast.Attribute) else (
+                t.id if isinstance(t, ast.Name) else None
+            )
+            if name and any(
+                frag in name.lower() for frag in _SUSPECT_TARGET_FRAGMENTS
+            ):
+                return True
+        return False
+
+
+ACTOR_RULES = [
+    BareThreadRule(),
+    BlockingQueueOpRule(),
+    CrossThreadClientMutationRule(),
+    WallClockArithRule(),
+]
