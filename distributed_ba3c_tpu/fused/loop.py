@@ -40,6 +40,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_ba3c_tpu.audit import tripwire_jit
 from distributed_ba3c_tpu.config import BA3CConfig
 from distributed_ba3c_tpu.models.a3c import BA3CNet
 from distributed_ba3c_tpu.ops.gradproc import grad_summaries, inject_learning_rate
@@ -330,7 +331,8 @@ def make_fused_step(
         in_specs=(state_specs, P(), P()),
         out_specs=(state_specs, P()),
     )
-    jitted = jax.jit(sharded, donate_argnums=(0,))
+    # registered audit entry point (distributed_ba3c_tpu/audit.py)
+    jitted = tripwire_jit("fused.step", sharded, donate_argnums=(0,))
 
     def step(state, entropy_beta, learning_rate=None):
         if learning_rate is None:
@@ -382,6 +384,7 @@ def make_fused_step(
     step.mesh = mesh
     step.rollout_len = rollout_len
     step.steps_per_dispatch = steps_per_dispatch
+    step.audit_jit = jitted  # tools/ba3caudit traces THIS program
     return step
 
 
@@ -463,7 +466,8 @@ def make_greedy_eval(
         in_specs=(P(), P()),
         out_specs=(P(), P(), P()),
     )
-    jitted = jax.jit(sharded)
+    # registered audit entry point (distributed_ba3c_tpu/audit.py)
+    jitted = tripwire_jit("fused.greedy_eval", sharded)
 
     def evaluate(params, seed):
         """``seed``: int (preferred) — PRNGKey arrays are coerced."""
@@ -477,6 +481,7 @@ def make_greedy_eval(
         mean, mx, n = jitted(params, jnp.uint32(arr))
         return float(mean), float(mx), int(n)
 
+    evaluate.audit_jit = jitted  # tools/ba3caudit traces THIS program
     return evaluate
 
 

@@ -26,6 +26,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_ba3c_tpu.audit import tripwire_jit
 from distributed_ba3c_tpu.config import BA3CConfig
 from distributed_ba3c_tpu.models.a3c import BA3CNet
 from distributed_ba3c_tpu.ops.gradproc import grad_summaries, inject_learning_rate
@@ -149,7 +150,9 @@ def make_train_step(
         out_specs=(replicated, replicated),
     )
 
-    jitted = jax.jit(sharded, donate_argnums=(0,))
+    # registered audit entry point (distributed_ba3c_tpu/audit.py): under
+    # BA3C_AUDIT=1 a post-warmup retrace raises instead of silently stalling
+    jitted = tripwire_jit("parallel.train_step", sharded, donate_argnums=(0,))
 
     def step(state, batch, entropy_beta, learning_rate=None):
         if learning_rate is None:
@@ -165,4 +168,5 @@ def make_train_step(
     step.batch_sharding = NamedSharding(mesh, batch_spec)
     step.state_sharding = NamedSharding(mesh, replicated)
     step.mesh = mesh
+    step.audit_jit = jitted  # tools/ba3caudit traces THIS program
     return step

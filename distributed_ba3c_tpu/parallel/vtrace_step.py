@@ -27,6 +27,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_ba3c_tpu.audit import tripwire_jit
 from distributed_ba3c_tpu.config import BA3CConfig
 from distributed_ba3c_tpu.models.a3c import BA3CNet
 from distributed_ba3c_tpu.ops.gradproc import grad_summaries, inject_learning_rate
@@ -133,7 +134,8 @@ def make_vtrace_train_step(
         in_specs=(replicated, specs, replicated, replicated),
         out_specs=(replicated, replicated),
     )
-    jitted = jax.jit(sharded, donate_argnums=(0,))
+    # registered audit entry point (distributed_ba3c_tpu/audit.py)
+    jitted = tripwire_jit("parallel.vtrace_step", sharded, donate_argnums=(0,))
 
     def step(state, batch, entropy_beta, learning_rate=None):
         if learning_rate is None:
@@ -150,4 +152,5 @@ def make_vtrace_train_step(
     }
     step.state_sharding = NamedSharding(mesh, replicated)
     step.mesh = mesh
+    step.audit_jit = jitted  # tools/ba3caudit traces THIS program
     return step

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_ba3c_tpu.audit import tripwire_jit
 from distributed_ba3c_tpu.utils.concurrency import (
     StoppableThread,
     queue_put_stoppable,
@@ -35,6 +36,43 @@ from distributed_ba3c_tpu.utils.concurrency import (
 
 def _next_pow2(n: int) -> int:
     return 1 << (n - 1).bit_length()
+
+
+def make_fwd_sample(model, greedy: bool = False) -> Callable:
+    """The action server's compiled program: forward + on-device sampling.
+
+    Module-level (not a closure in ``__init__``) so the audit registry
+    (distributed_ba3c_tpu/audit.py, entry ``predict.server``) traces the
+    same function the live predictor jits.
+    """
+
+    def fwd_sample(params, states, key):
+        out = model.apply({"params": params}, states)
+        if greedy:
+            actions = jnp.argmax(out.logits, axis=-1)
+        else:
+            actions = jax.random.categorical(key, out.logits, axis=-1)
+        actions = actions.astype(jnp.int32)
+        # log mu(a|s): the behavior policy record V-trace needs
+        log_probs = jax.nn.log_softmax(out.logits, axis=-1)
+        logp = jnp.take_along_axis(log_probs, actions[:, None], axis=-1)[:, 0]
+        # PACK everything into ONE array: the host fetches a single
+        # buffer per serve. Measured on the tunneled-TPU dev setup:
+        # device readback costs ~135 ms PER ARRAY regardless of size
+        # (latency, not bandwidth), so four separate fetches were 540 ms
+        # per serving call — 400x the 1.3 ms compute (see PERF.md).
+        greedy_actions = jnp.argmax(out.logits, axis=-1)
+        packed = jnp.stack(
+            [
+                actions.astype(jnp.float32),
+                out.value,
+                logp,
+                greedy_actions.astype(jnp.float32),
+            ]
+        )
+        return packed  # [4, B] float32
+
+    return fwd_sample
 
 
 class BatchedPredictor:
@@ -71,33 +109,13 @@ class BatchedPredictor:
         self._greedy = greedy
         self._stop_evt = threading.Event()
 
-        def fwd_sample(params, states, key):
-            out = model.apply({"params": params}, states)
-            if greedy:
-                actions = jnp.argmax(out.logits, axis=-1)
-            else:
-                actions = jax.random.categorical(key, out.logits, axis=-1)
-            actions = actions.astype(jnp.int32)
-            # log mu(a|s): the behavior policy record V-trace needs
-            log_probs = jax.nn.log_softmax(out.logits, axis=-1)
-            logp = jnp.take_along_axis(log_probs, actions[:, None], axis=-1)[:, 0]
-            # PACK everything into ONE array: the host fetches a single
-            # buffer per serve. Measured on the tunneled-TPU dev setup:
-            # device readback costs ~135 ms PER ARRAY regardless of size
-            # (latency, not bandwidth), so four separate fetches were 540 ms
-            # per serving call — 400x the 1.3 ms compute (see PERF.md).
-            greedy_actions = jnp.argmax(out.logits, axis=-1)
-            packed = jnp.stack(
-                [
-                    actions.astype(jnp.float32),
-                    out.value,
-                    logp,
-                    greedy_actions.astype(jnp.float32),
-                ]
-            )
-            return packed  # [4, B] float32
-
-        self._fwd = jax.jit(fwd_sample)
+        # registered audit entry point (distributed_ba3c_tpu/audit.py).
+        # auto_arm=False: the pow-2 bucket warmup is a LEGITIMATE multi-shape
+        # compile sequence; warmup() arms the tripwire when it completes, so
+        # only a new bucket size appearing mid-serving raises.
+        self._fwd = tripwire_jit(
+            "predict.server", make_fwd_sample(model, greedy), auto_arm=False
+        )
         self.threads: List[StoppableThread] = [
             StoppableThread(
                 target=self._worker, daemon=True, name=f"predictor-{i}"
@@ -120,6 +138,9 @@ class BatchedPredictor:
         while b <= _next_pow2(self._batch_size):
             self._run_device(np.zeros((b, *state_shape), dtype))
             b *= 2
+        # BA3C_AUDIT=1: buckets compiled — any retrace from here on is a
+        # mid-serving stall and raises AuditError
+        getattr(self._fwd, "arm", lambda: None)()
 
     def stop(self) -> None:
         self._stop_evt.set()
@@ -153,8 +174,31 @@ class BatchedPredictor:
 
         ``actions`` follow the serving policy (sampled, or argmax when
         ``greedy=True``); ``greedy_actions`` are always the argmax — the
-        Evaluator consumes those without a second device call."""
-        actions, values, _, greedy_actions = self._run_device(np.asarray(states))
+        Evaluator consumes those without a second device call. Inputs
+        larger than the serving bucket (an Evaluator with more envs than
+        ``batch_size``) are chunked to it, so no bucket beyond warmup's is
+        ever compiled — bounded device memory, and no post-warmup retrace
+        for the BA3C_AUDIT=1 tripwire to refuse."""
+        states = np.asarray(states)
+        cap = _next_pow2(max(self._batch_size, 1))
+        if states.shape[0] <= cap:
+            actions, values, _, greedy_actions = self._run_device(states)
+            return actions, values, greedy_actions
+        # dispatch EVERY chunk before fetching any: jax dispatch is async,
+        # so the chunks' compute overlaps while fetches (the ~135 ms/array
+        # latency documented above) drain in order — fetching inside the
+        # dispatch loop would serialize compute behind readback. Snapshot
+        # params once: a learner publish between chunks must not split one
+        # logical batch across two policies.
+        params = self._params
+        pending = [
+            self._dispatch(params, states[i:i + cap])
+            for i in range(0, states.shape[0], cap)
+        ]
+        parts = [self._unpack(np.asarray(packed), k) for k, packed in pending]
+        actions, values, _, greedy_actions = (
+            np.concatenate(p) for p in zip(*parts)
+        )
         return actions, values, greedy_actions
 
     # -- internals ---------------------------------------------------------
@@ -163,21 +207,31 @@ class BatchedPredictor:
             self._key, sub = jax.random.split(self._key)
         return sub
 
-    def _run_device(self, batch: np.ndarray):
+    def _dispatch(self, params, batch: np.ndarray):
+        """Pad to the pow-2 bucket and dispatch (async); no host fetch.
+
+        ``params`` is passed explicitly so a multi-chunk caller serves ONE
+        parameter version even if the learner publishes mid-batch."""
         k = batch.shape[0]
         padded = _next_pow2(max(k, 1))
         if padded != k:
             pad = np.zeros((padded - k, *batch.shape[1:]), batch.dtype)
             batch = np.concatenate([batch, pad], axis=0)
-        packed = np.asarray(  # ONE device->host fetch (see fwd_sample)
-            self._fwd(self._params, batch, self._next_key())
-        )
+        return k, self._fwd(params, batch, self._next_key())
+
+    @staticmethod
+    def _unpack(packed: np.ndarray, k: int):
         return (
             packed[0, :k].astype(np.int32),
             packed[1, :k],
             packed[2, :k],
             packed[3, :k].astype(np.int32),
         )
+
+    def _run_device(self, batch: np.ndarray):
+        k, packed = self._dispatch(self._params, batch)
+        # ONE device->host fetch (see fwd_sample)
+        return self._unpack(np.asarray(packed), k)
 
     def _fetch_batch(self, t: StoppableThread):
         """Block for one task, then coalesce toward a full batch.

@@ -47,8 +47,13 @@ MODES = ("wait", "fail", "off")
 # there would corrupt the contract. sys.stderr is resolved at CALL time —
 # a functools.partial bound the import-time stream and silently wrote to a
 # stale object under any later redirection (pytest capture, daemonization).
-def _stderr_print(*args, **kwargs) -> None:
+# Public: the bench scripts share the stdout-JSON contract and import this
+# (ba3clint A5 forbids cross-module imports of underscore names).
+def stderr_print(*args, **kwargs) -> None:
     print(*args, file=sys.stderr, flush=True, **kwargs)
+
+
+_stderr_print = stderr_print  # private alias kept for in-module history
 
 
 def lock_path() -> str:
@@ -142,7 +147,7 @@ class TpuLock:
         mode: str = "wait",
         poll_s: float = 5.0,
         timeout_s: Optional[float] = None,
-        log: Callable[[str], None] = _stderr_print,
+        log: Callable[[str], None] = stderr_print,
     ) -> "TpuLock":
         if mode not in MODES:
             raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
@@ -199,7 +204,7 @@ def guard_tpu(
     mode: str = "wait",
     poll_s: float = 5.0,
     timeout_s: Optional[float] = None,
-    log: Callable[[str], None] = _stderr_print,
+    log: Callable[[str], None] = stderr_print,
 ) -> Optional[TpuLock]:
     """Entry-point helper: acquire the host-local TPU claim unless this
     process is on the CPU platform (or mode='off'). Call BEFORE the first

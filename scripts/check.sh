@@ -1,22 +1,37 @@
 #!/usr/bin/env bash
 # Pre-commit entry point: the repo's static gates, fast enough to run on
-# every commit (no tests, no device — pure host-side analysis).
+# every commit (no tests, no accelerator — gates 1-2 are pure host-side
+# analysis; gate 3 traces/compiles the registered jit programs on a pinned
+# 2-device CPU platform, ~25 s, and never touches the TPU pool).
 #
 #   ./scripts/check.sh
 #
 # Gate 1: ba3clint — the repo-specific AST lint suite (rule catalog in
 #         docs/static_analysis.md). Exit 1 on any unsuppressed finding.
 # Gate 2: compileall — every shipped .py must at least byte-compile.
+# Gate 3: ba3caudit — trace-level (jaxpr/HLO) invariants of the hot-path
+#         entry points against the committed audit_manifest.json (same
+#         doc). Exit 1 on any T-rule violation or manifest drift.
 #
-# CI runs exactly this script (.github/workflows/ci.yml `lint` job), so a
-# clean local run means a clean CI lint job.
+# CI runs exactly this script (.github/workflows/ci.yml `lint` job runs
+# gates 1-2; the `audit` job runs gate 3), so a clean local run means
+# clean CI static gates.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== ba3clint =="
-python -m tools.ba3clint distributed_ba3c_tpu scripts train.py bench.py
+python -m tools.ba3clint distributed_ba3c_tpu tools scripts train.py bench.py
 
 echo "== compileall =="
 python -m compileall -q distributed_ba3c_tpu tools scripts tests train.py bench.py
+
+if [[ "${BA3C_CHECK_NO_AUDIT:-0}" != 1 ]]; then
+  echo "== ba3caudit =="
+  python -m tools.ba3caudit
+else
+  # CI's lint job installs no jax; the dedicated `audit` job owns gate 3
+  # there. Locally, never set this — the full pre-commit is all 3 gates.
+  echo "== ba3caudit skipped (BA3C_CHECK_NO_AUDIT=1) =="
+fi
 
 echo "check.sh: all gates passed"

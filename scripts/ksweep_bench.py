@@ -24,7 +24,7 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from distributed_ba3c_tpu.utils.devicelock import _stderr_print, guard_tpu  # noqa: E402
+from distributed_ba3c_tpu.utils.devicelock import guard_tpu, stderr_print  # noqa: E402
 
 
 def main() -> None:
@@ -54,7 +54,7 @@ def main() -> None:
         )
         out[K] = r["value"]
         windows[K] = r["window_rates"]
-        _stderr_print(
+        stderr_print(
             f"K={K}: {r['value']} env-steps/s/chip  windows={r['window_rates']}"
         )
     print(json.dumps({

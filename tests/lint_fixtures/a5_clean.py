@@ -1,0 +1,11 @@
+"""A5 clean: public from-imports, dunders, and module-local privates."""
+
+from __future__ import annotations
+
+import queue as _queue_alias  # aliasing PUBLIC names privately is fine
+from distributed_ba3c_tpu.utils.devicelock import stderr_print  # noqa: F401
+from os.path import __all__ as _os_path_all  # dunder names are not private
+
+
+def _helper():  # defining privates locally is the point of the convention
+    return _queue_alias.Queue(), _os_path_all

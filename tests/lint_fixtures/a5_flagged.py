@@ -1,0 +1,10 @@
+"""A5 flagged: from-imports of underscore-private names (3 findings)."""
+
+from distributed_ba3c_tpu.utils.devicelock import _stderr_print  # noqa: F401
+from queue import _PySimpleQueue as SimpleQueueImpl  # noqa: F401
+from .a5_clean import _helper  # noqa: F401
+
+
+def use():
+    _stderr_print("hi")
+    return SimpleQueueImpl, _helper

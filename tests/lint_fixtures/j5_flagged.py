@@ -1,5 +1,7 @@
-"""J5 flagged: donated buffer read after the donating call."""
+"""J5 flagged: donated buffer read after the donating call (2 findings)."""
 import jax
+
+from distributed_ba3c_tpu.audit import tripwire_jit
 
 
 def train_step(state, batch):
@@ -12,4 +14,14 @@ jitted = jax.jit(train_step, donate_argnums=(0,))
 def run(state, batch, predictor):
     new_state = jitted(state, batch)
     predictor.update(state)  # J5: `state` was donated — buffer is gone
+    return new_state
+
+
+# the hot-path sites jit through the audit tripwire — same donation rules
+wired = tripwire_jit("fixture.step", train_step, donate_argnums=(0,))
+
+
+def run_wired(state, batch, predictor):
+    new_state = wired(state, batch)
+    predictor.update(state)  # J5: donated through tripwire_jit
     return new_state

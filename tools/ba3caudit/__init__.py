@@ -1,0 +1,90 @@
+"""ba3caudit: trace-level (jaxpr/HLO) invariant auditor for the BA3C stack.
+
+Usage:
+    python -m tools.ba3caudit [--entries a,b] [--json] [--update-manifest]
+
+Where ``ba3clint`` reads the *source*, ba3caudit reads the *compiled
+program*: it builds every entry point registered in
+``distributed_ba3c_tpu/audit.py`` at canonical abstract shapes, traces it
+(jaxpr), lowers and compiles it (HLO + cost analysis), and checks the
+T-series invariants — bf16 conv policy (T1), materialized buffer donation
+(T2), exactly-once gradient all-reduce (T3), no host callbacks (T4), and
+FLOPs/HBM-bytes drift against the checked-in ``audit_manifest.json`` (T5).
+Rule catalog: docs/static_analysis.md.
+
+The runtime half lives in ``distributed_ba3c_tpu/audit.py``: ``BA3C_AUDIT=1``
+arms a retrace tripwire on the same registered jit sites.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.ba3caudit.rules import Finding, Measurement  # noqa: F401 (public API)
+
+
+def run_audit(
+    entries: Optional[Sequence[str]] = None,
+    manifest_path: Optional[str] = None,
+    update_manifest: bool = False,
+    tolerance: float = 0.25,
+) -> Tuple[Dict[str, "Measurement"], List["Finding"]]:
+    """Measure the registered entry points and run every T-rule.
+
+    Returns (measurements by entry name, findings). With
+    ``update_manifest=True`` the measured values are written to the manifest
+    and T5 is reported against the FRESH values (i.e. never fires).
+    """
+    import jax
+
+    from distributed_ba3c_tpu import audit
+    from tools.ba3caudit import manifest as manifest_mod
+    from tools.ba3caudit import rules
+
+    names = list(entries) if entries else audit.entry_names()
+    path = manifest_path or manifest_mod.DEFAULT_MANIFEST
+    stored = dict(manifest_mod.load(path) or {})
+    stored_meta = stored.pop(manifest_mod.META_KEY, None)
+
+    measurements: Dict[str, rules.Measurement] = {}
+    findings: List[rules.Finding] = []
+    for name in names:
+        target = audit.build_entry(name)
+        m = rules.measure(target)
+        measurements[name] = m
+        entry_manifest = (
+            m.manifest_entry() if update_manifest else stored.get(name)
+        )
+        findings.extend(rules.check_entry(target, m, entry_manifest, tolerance))
+
+    # a manifest key with no registered entry point is a pin that stopped
+    # gating anything (renamed/deleted entry) — zombie pins mislead every
+    # future manifest-diff review, so they are findings, not warnings
+    for stale in sorted(set(stored) - set(audit.entry_names())):
+        if update_manifest:
+            continue  # pruned by the rewrite below
+        findings.append(rules.Finding(
+            stale, "T5",
+            "manifest entry has no registered entry point (renamed or "
+            "deleted?) — prune it with --update-manifest, or restore the "
+            "registration",
+        ))
+
+    if update_manifest:
+        # keep still-registered pins not re-measured this run (an
+        # --entries subset), drop everything unregistered
+        merged = {
+            n: v for n, v in stored.items() if n in audit.entry_names()
+        }
+        merged.update({n: m.manifest_entry() for n, m in measurements.items()})
+        # only a FULL re-measure may re-stamp the toolchain: a subset
+        # update under a new jax would stamp the new version over entries
+        # still holding old-toolchain numbers — suppressing the exact
+        # mismatch hint built for that situation
+        full = set(names) >= set(audit.entry_names())
+        merged[manifest_mod.META_KEY] = (
+            {"jax": jax.__version__} if full or not stored_meta
+            else stored_meta
+        )
+        manifest_mod.save(merged, path)
+    return measurements, findings

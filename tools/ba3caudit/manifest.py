@@ -1,0 +1,39 @@
+"""The compiled-program manifest: load/save audit_manifest.json.
+
+The manifest is the repo's pinned record of what each hot-path entry point's
+compiled program looks like at the canonical shapes — FLOPs, HBM bytes,
+collective census, conv/dot counts, materialized aliases. CI diffs the live
+measurement against it (rules.check_t5); `--update-manifest` rewrites it,
+and the reviewed git diff of that rewrite is the change-control for the
+compiled program.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+#: reserved manifest key recording the toolchain (jax version) the numbers
+#: were measured under — T5 values are XLA outputs, so regenerating under a
+#: different jax is expected to drift; CI pins jax to this version
+META_KEY = "_meta"
+
+DEFAULT_MANIFEST = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "audit_manifest.json",
+)
+
+
+def load(path: str = DEFAULT_MANIFEST) -> Optional[Dict[str, dict]]:
+    """The manifest dict, or None when the file does not exist yet."""
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def save(entries: Dict[str, dict], path: str = DEFAULT_MANIFEST) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(entries, fh, indent=2, sort_keys=True)
+        fh.write("\n")

@@ -1,0 +1,224 @@
+"""The T-series: IR-level invariants checked per registered entry point.
+
+Rule catalog (rationale and worked regressions: docs/static_analysis.md):
+
+    T1 conv-dtype-policy     every conv eqn computes in the declared dtype
+    T2 donation-materialized every donated leaf aliases an output buffer
+    T3 grad-allreduce-census each non-scalar param grad psum'd exactly once
+    T4 no-host-callbacks     no callback/debug/infeed primitives in hot paths
+    T5 manifest-drift        FLOPs/HBM bytes/censuses match audit_manifest.json
+
+T1–T4 are absolute (they hold for ANY build of the entry point); T5 pins the
+measured program against the checked-in manifest so silent cost/shape
+regressions fail CI with a readable diff.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Dict, List, Optional
+
+from tools.ba3caudit import ir
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    entry: str
+    rule: str
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Measurement:
+    """Everything the analyzer extracted for one entry point."""
+
+    entry: str
+    collectives: Dict[str, int]
+    host_callbacks: Dict[str, int]
+    conv_dtypes: List[tuple]
+    dot_dtypes: Dict[str, int]
+    nonscalar_psum_shapes: List[tuple]
+    aliased_inputs: List[int]
+    flops: float
+    bytes_accessed: float
+
+    def manifest_entry(self) -> dict:
+        return {
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "collectives": dict(sorted(self.collectives.items())),
+            "conv_eqns": len(self.conv_dtypes),
+            "dot_dtypes": dict(sorted(self.dot_dtypes.items())),
+            "grad_psums": len(self.nonscalar_psum_shapes),
+            "aliased_inputs": len(self.aliased_inputs),
+        }
+
+
+def measure(target) -> Measurement:
+    """Trace + lower + compile one TraceTarget and extract the facts."""
+    traced = target.jit_fn.trace(*target.args)
+    jaxpr = traced.jaxpr
+    compiled = traced.lower().compile()
+    return Measurement(
+        entry=target.name,
+        collectives=dict(ir.collective_census(jaxpr)),
+        host_callbacks=dict(ir.host_callback_census(jaxpr)),
+        conv_dtypes=ir.conv_operand_dtypes(jaxpr),
+        dot_dtypes=dict(ir.dot_dtype_census(jaxpr)),
+        nonscalar_psum_shapes=ir.nonscalar_psum_shapes(jaxpr),
+        aliased_inputs=ir.input_aliases(compiled.as_text()),
+        **ir.cost_metrics(compiled),
+    )
+
+
+# --------------------------------------------------------------------------
+# T1–T4: absolute invariants
+# --------------------------------------------------------------------------
+
+
+def check_t1(target, m: Measurement) -> List[Finding]:
+    bad = [
+        dts for dts in m.conv_dtypes
+        if any(d != target.conv_dtype for d in dts)
+    ]
+    if not bad:
+        return []
+    return [Finding(
+        m.entry, "T1",
+        f"{len(bad)}/{len(m.conv_dtypes)} conv eqns compute outside the "
+        f"{target.conv_dtype} policy (operand dtypes: "
+        f"{sorted(set(bad))}) — f32 leaked into the conv stack halves MXU "
+        "throughput; check the astype boundaries in models/a3c.py",
+    )]
+
+
+def check_t2(target, m: Measurement) -> List[Finding]:
+    expected = set(target.donated_nonscalar_indices)
+    got = set(m.aliased_inputs)
+    if not expected:
+        if got:
+            return [Finding(
+                m.entry, "T2",
+                f"{len(got)} input buffers alias outputs but the entry "
+                "declares no donation — an unintended alias can free a "
+                "buffer a caller still reads",
+            )]
+        return []
+    missing = sorted(expected - got)
+    if not missing:
+        return []
+    return [Finding(
+        m.entry, "T2",
+        f"donation NOT fully materialized: {len(missing)}/{len(expected)} "
+        f"donated non-scalar state leaves (input indices {missing[:8]}"
+        f"{'…' if len(missing) > 8 else ''}) have no output alias in the "
+        "compiled module. jax only WARNS when XLA drops a donation; every "
+        "dropped leaf doubles its HBM footprint on each step",
+    )]
+
+
+def check_t3(target, m: Measurement) -> List[Finding]:
+    out: List[Finding] = []
+    if not target.allow_collectives:
+        if m.collectives:
+            out.append(Finding(
+                m.entry, "T3",
+                f"collectives in a single-device program: {m.collectives} — "
+                "a mesh sharding leaked into this entry point",
+            ))
+        return out
+    got = Counter(m.nonscalar_psum_shapes)
+    want = Counter(tuple(s) for s in (target.grad_shapes or []))
+    if got == want:
+        return out
+    missing = want - got
+    extra = got - want
+    if missing:
+        out.append(Finding(
+            m.entry, "T3",
+            f"{sum(missing.values())} param grad(s) NEVER all-reduced on the "
+            f"data axis (shapes {sorted(missing)}): each device applies a "
+            "shard-local gradient and replicas silently diverge",
+        ))
+    if extra:
+        out.append(Finding(
+            m.entry, "T3",
+            f"{sum(extra.values())} extra non-scalar psum(s) (shapes "
+            f"{sorted(extra)}): a gradient reduced more than once is scaled "
+            "by the axis size (the double-pmean bug class), or a non-grad "
+            "tensor is paying an all-reduce it doesn't need",
+        ))
+    return out
+
+
+def check_t4(_target, m: Measurement) -> List[Finding]:
+    if not m.host_callbacks:
+        return []
+    return [Finding(
+        m.entry, "T4",
+        f"host callback primitives in a hot path: {m.host_callbacks} — "
+        "every invocation is a device->host round trip inside the step "
+        "(delete the debug print / move the callback outside the jit)",
+    )]
+
+
+# --------------------------------------------------------------------------
+# T5: manifest drift
+# --------------------------------------------------------------------------
+
+#: fields compared exactly (integer program structure)
+EXACT_FIELDS = ("collectives", "conv_eqns", "dot_dtypes", "grad_psums",
+                "aliased_inputs")
+#: fields compared within relative tolerance (XLA cost model outputs)
+TOLERANT_FIELDS = ("flops", "bytes_accessed")
+
+
+def check_t5(m: Measurement, manifest_entry: Optional[dict],
+             tolerance: float) -> List[Finding]:
+    if manifest_entry is None:
+        return [Finding(
+            m.entry, "T5",
+            "entry point missing from audit_manifest.json — run "
+            "`python -m tools.ba3caudit --update-manifest` and commit the "
+            "diff (reviewing it IS the audit)",
+        )]
+    out: List[Finding] = []
+    measured = m.manifest_entry()
+    for field in EXACT_FIELDS:
+        if measured[field] != manifest_entry.get(field):
+            out.append(Finding(
+                m.entry, "T5",
+                f"{field} drifted: manifest {manifest_entry.get(field)!r} "
+                f"-> measured {measured[field]!r} (exact field; if the "
+                "change is intended, --update-manifest and commit)",
+            ))
+    for field in TOLERANT_FIELDS:
+        want = float(manifest_entry.get(field, 0.0))
+        have = measured[field]
+        base = max(abs(want), 1.0)
+        rel = abs(have - want) / base
+        if rel > tolerance:
+            out.append(Finding(
+                m.entry, "T5",
+                f"{field} drifted {rel:+.1%} (manifest {want:.6g} -> "
+                f"measured {have:.6g}, tolerance {tolerance:.0%}) — a "
+                "recompile-shape or cost regression; if intended, "
+                "--update-manifest and commit",
+            ))
+    return out
+
+
+def check_entry(target, m: Measurement, manifest_entry: Optional[dict],
+                tolerance: float) -> List[Finding]:
+    """Run every T-rule for one measured entry point."""
+    out: List[Finding] = []
+    out += check_t1(target, m)
+    out += check_t2(target, m)
+    out += check_t3(target, m)
+    out += check_t4(target, m)
+    out += check_t5(m, manifest_entry, tolerance)
+    return out

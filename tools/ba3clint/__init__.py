@@ -10,9 +10,10 @@ Two rule families (catalog: docs/static_analysis.md):
   functions (J1), ``jax.jit`` built inside a loop (J2), non-static literal
   args to jitted callables (J3), PRNGKey reuse without ``split`` (J4),
   reading a donated buffer after the call (J5).
-* **A-series** — actor-plane concurrency conventions: bare threads (A1),
+* **A-series** — actor-plane and API-hygiene conventions: bare threads (A1),
   blocking queue ops without timeouts (A2), cross-thread client-state
-  mutation from closures (A3), wall-clock timeout arithmetic (A4).
+  mutation from closures (A3), wall-clock timeout arithmetic (A4),
+  from-imports of underscore-private names (A5).
 
 Per-line suppression: ``# ba3clint: disable=A2`` (comma-separate ids;
 ``disable=all`` kills everything on the line). A standalone comment line

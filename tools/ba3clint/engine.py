@@ -156,6 +156,18 @@ def chain_root(node: ast.AST) -> ast.AST:
 
 _JIT_NAMES = {"jax.jit", "jax.pjit", "jit", "pjit"}
 
+#: ``audit.tripwire_jit(name, fn, **jit_kwargs)`` — the repo's hot-path jit
+#: wrapper (distributed_ba3c_tpu/audit.py). Jit-like for every J-series
+#: purpose (donation, traced body, retrace hazards), with the function at
+#: positional index 1 instead of 0. Without this entry, switching a site
+#: from jax.jit to tripwire_jit would silently blind J5/J3/J1 to exactly
+#: the five sites the gate most needs to watch.
+_TRIPWIRE_JIT_NAMES = {
+    "tripwire_jit",
+    "audit.tripwire_jit",
+    "distributed_ba3c_tpu.audit.tripwire_jit",
+}
+
 
 def _donate_positions(call: ast.Call) -> Tuple[int, ...]:
     for kw in call.keywords:
@@ -208,8 +220,9 @@ class ModuleInfo:
                     nm = dotted_name(t)
                     if nm:
                         self.jitted[nm] = donate
-                if call.args:
-                    fn = dotted_name(call.args[0])
+                fn_idx = self._jit_fn_arg_index(call)
+                if len(call.args) > fn_idx:
+                    fn = dotted_name(call.args[fn_idx])
                     if fn and "." not in fn:
                         self.jitted_fn_defs.add(fn)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -242,7 +255,15 @@ class ModuleInfo:
     def _is_jit_expr(self, node: ast.AST) -> bool:
         if isinstance(node, ast.Call):
             node = node.func
-        return self.resolve(node) in _JIT_NAMES
+        resolved = self.resolve(node)
+        return resolved in _JIT_NAMES or resolved in _TRIPWIRE_JIT_NAMES
+
+    def _jit_fn_arg_index(self, node: ast.AST) -> int:
+        """Positional index of the traced function in a jit-like call:
+        0 for jax.jit/pjit, 1 for tripwire_jit(name, fn, ...)."""
+        if isinstance(node, ast.Call):
+            node = node.func
+        return 1 if self.resolve(node) in _TRIPWIRE_JIT_NAMES else 0
 
 
 @dataclasses.dataclass

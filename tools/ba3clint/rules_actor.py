@@ -285,9 +285,49 @@ class WallClockArithRule(Rule):
         return False
 
 
+def _is_dunder(name: str) -> bool:
+    return name.startswith("__") and name.endswith("__")
+
+
+class PrivateImportRule(Rule):
+    """A5: ``from <module> import _private`` — importing underscore names.
+
+    A leading underscore is the module's statement that the name may be
+    renamed, re-scoped, or deleted without notice; an external import turns
+    that private detail into silent API surface (``scripts/ksweep_bench.py``
+    depended on ``devicelock._stderr_print`` exactly this way — ADVICE r5).
+    Promote the name to a public one (keep a private alias in the owning
+    module if its history matters), or suppress with the justification for
+    why the coupling is intended.
+    """
+
+    id = "A5"
+    name = "private-import"
+    summary = "from-import of an underscore-private name couples to another module's internals"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                nm = alias.name
+                if nm.startswith("_") and not _is_dunder(nm):
+                    mod = ("." * node.level) + (node.module or "")
+                    yield ctx.finding(
+                        self, node,
+                        f"importing private name {nm!r} from {mod!r} — "
+                        "underscore names are the owning module's internals; "
+                        "promote it to a public name (keep a private alias) "
+                        "or suppress with the coupling justification",
+                    )
+
+
 ACTOR_RULES = [
     BareThreadRule(),
     BlockingQueueOpRule(),
     CrossThreadClientMutationRule(),
     WallClockArithRule(),
+    PrivateImportRule(),
 ]
