@@ -24,11 +24,60 @@ this number.)
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
 
 BASELINE_ENV_STEPS_PER_SEC = 80_000.0  # recalled 64-node cluster rate, UNVERIFIED
+
+# bf16 peak FLOP/s by device kind — the MFU denominator. Only kinds this
+# project has actually run on; unknown kinds report mfu=null rather than a
+# made-up denominator.
+_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,  # v5e datasheet bf16
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+}
+
+
+def _mfu(per_chip_rate: float) -> dict:
+    """Model FLOPs utilization of the fused step at the measured rate.
+
+    Numerator: the audit manifest's PINNED per-sample FLOPs for the
+    ``fused.step`` entry point (tools/ba3caudit T5 — canonical shape 4 envs
+    x 4 rollout = 16 samples/step; conv/matmul cost scales linearly in
+    samples, and the per-update fixed terms (Adam, bookkeeping) are <0.01
+    us/sample at real shapes, PERF.md round 3). Keeping the numerator
+    manifest-pinned means MFU moves only when the measured RATE moves — a
+    program change that alters FLOPs shows up as a T5 audit finding first.
+    """
+    try:
+        with open(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "audit_manifest.json")
+        ) as fh:
+            manifest = json.load(fh)
+        flops = float(manifest["fused.step"]["flops"])
+        # inside the try: an un-importable audit module (jax drift the
+        # shims don't cover) must degrade to mfu=null, not kill the bench
+        from distributed_ba3c_tpu.audit import CANONICAL_MESH_DEVICES
+    except (OSError, KeyError, ValueError, ImportError):
+        return {"mfu": None}
+
+    canonical_samples = (2 * CANONICAL_MESH_DEVICES) * 4  # n_envs x rollout
+    per_sample = flops / canonical_samples
+    kind = jax.devices()[0].device_kind
+    peak = _PEAK_FLOPS.get(kind)
+    out = {
+        "flops_per_sample": round(per_sample, 1),
+        "device_kind": kind,
+    }
+    if peak is None:
+        out["mfu"] = None  # unknown silicon: no honest denominator
+    else:
+        out["mfu"] = round(per_chip_rate * per_sample / peak, 4)
+    return out
 
 
 def bench_fused(
@@ -111,6 +160,9 @@ def bench_fused(
         "unit": "env-steps/sec/chip",
         # north-star compares the HOST-aggregate rate to the 64-node cluster
         "vs_baseline": round(host_rate / BASELINE_ENV_STEPS_PER_SEC, 3),
+        # MFU pins the 0.8x plateau to silicon utilization (VERDICT r5 #3):
+        # manifest-pinned FLOPs/sample x measured rate / bf16 peak
+        **_mfu(per_chip),
         # methodology (ADVICE r3): shape + best-of-N policy are part of the
         # number — without them BENCH_r{N}.json files are not comparable
         "n_envs": n_envs,
@@ -122,21 +174,66 @@ def bench_fused(
     }
 
 
+def make_null_predictor(model, params, n_actions: int, **kw):
+    """A BatchedPredictor whose 'device' is host numpy: identical queueing,
+    coalescing, block handling and callback machinery — only ``_run_device``
+    is replaced by thread-safe host-side random actions. The plane's own
+    ceiling measurement (PERF.md; scripts/plane_bench.py) uses this to take
+    the device (and, on this rig, the tunnel RTT) out of the loop."""
+    import threading
+
+    import numpy as np
+
+    from distributed_ba3c_tpu.predict.server import BatchedPredictor
+
+    class _NullDevicePredictor(BatchedPredictor):
+        """Identical batching machinery; the 'device' is host numpy."""
+
+        def __init__(self, *a, **kws):
+            super().__init__(*a, **kws)
+            self._null_rng = np.random.default_rng(0)
+            # numpy Generators are not thread-safe and worker threads
+            # share this one (the real predictor guards its PRNG key
+            # with a lock — keep the invariant)
+            self._null_lock = threading.Lock()
+
+        def _run_device(self, batch):
+            k = batch.shape[0]
+            with self._null_lock:
+                acts = self._null_rng.integers(0, n_actions, k).astype(
+                    np.int32
+                )
+            vals = np.zeros(k, np.float32)
+            logp = np.full(k, -np.log(n_actions), np.float32)
+            return acts, vals, logp, acts
+
+    return _NullDevicePredictor(model, params, **kw)
+
+
 def bench_zmq_plane(
     game: str = "pong", n_envs: int = 256, seconds: float = 20.0,
-    null_device: bool = False,
+    null_device: bool = False, wire: str = "per-env",
+    envs_per_proc: int = 32, warmup_datapoints: int = 512,
+    windows: int = 1,
 ) -> dict:
     """Actor-plane throughput (BASELINE configs #1/#2): C++ batched env
     servers -> ZMQ -> master -> batched TPU predictor, counting n-step
     datapoints entering the train queue. Run via `python bench.py --plane zmq`
-    (the driver's default invocation stays the fused line).
+    (the driver's default invocation stays the fused line); the dedicated
+    plane instrument with both wires and both predictors in one JSON is
+    ``scripts/plane_bench.py``.
 
     ``null_device=True`` (``--plane zmq-null``) swaps the device forward for
     host-side random actions while keeping EVERY other stage — C++ envs,
-    msgpack serialization, ZMQ transport, master routing, batching/coalesce,
+    serialization, ZMQ transport, master routing, batching/coalesce,
     n-step assembly. That measures the plane's own ceiling with no device
     (and, on this rig, no tunnel RTT) in the loop: the number that separates
-    "the plane is slow" from "the tunneled device is slow" (PERF.md)."""
+    "the plane is slow" from "the tunneled device is slow" (PERF.md).
+
+    ``wire`` selects the env-server protocol: ``per-env`` (the reference's
+    B-messages-per-step shape, the historical 2,128/s ceiling) or ``block``
+    (one zero-copy multipart message per server per step,
+    docs/actor_plane.md)."""
     import queue
     import tempfile
 
@@ -155,40 +252,22 @@ def bench_zmq_plane(
         jax.random.PRNGKey(0), np.zeros((1, *cfg.state_shape), np.uint8)
     )["params"]
     # 2 worker threads (measured best on the tunneled dev chip: more threads
-    # fragment batches without overlapping the serialized link)
+    # fragment batches without overlapping the serialized link). Coalescing
+    # exists to multiply TINY per-env tasks per device call; a block already
+    # IS a full batch, so block wires serve greedily (waiting would only
+    # add latency to the lockstep round trip).
+    coalesce_ms = 5.0 if wire == "per-env" else 0.0
+    predict_bs = max(cfg.predict_batch_size, envs_per_proc)
     if null_device:
-
-        class _NullDevicePredictor(BatchedPredictor):
-            """Identical batching machinery; the 'device' is host numpy."""
-
-            def __init__(self, *a, **kw):
-                import threading
-
-                super().__init__(*a, **kw)
-                self._null_rng = np.random.default_rng(0)
-                # numpy Generators are not thread-safe and 2 worker threads
-                # share this one (the real predictor guards its PRNG key
-                # with a lock — keep the invariant)
-                self._null_lock = threading.Lock()
-
-            def _run_device(self, batch):
-                k = batch.shape[0]
-                with self._null_lock:
-                    acts = self._null_rng.integers(0, n_actions, k).astype(
-                        np.int32
-                    )
-                vals = np.zeros(k, np.float32)
-                logp = np.full(k, -np.log(n_actions), np.float32)
-                return acts, vals, logp, acts
-
-        predictor = _NullDevicePredictor(
-            model, params, batch_size=cfg.predict_batch_size, num_threads=2,
-            coalesce_ms=5.0,
+        predictor = make_null_predictor(
+            model, params, n_actions,
+            batch_size=predict_bs, num_threads=2,
+            coalesce_ms=coalesce_ms,
         )
     else:
         predictor = BatchedPredictor(
-            model, params, batch_size=cfg.predict_batch_size, num_threads=2,
-            coalesce_ms=5.0,
+            model, params, batch_size=predict_bs, num_threads=2,
+            coalesce_ms=coalesce_ms,
         )
         predictor.warmup(cfg.state_shape)
     tmp = tempfile.mkdtemp(prefix="ba3c-bench-")
@@ -198,10 +277,11 @@ def bench_zmq_plane(
         gamma=cfg.gamma, local_time_max=cfg.local_time_max,
         score_queue=queue.Queue(maxsize=100_000),
     )
-    per = 32
+    per = envs_per_proc
     procs = [
         native.CppEnvServerProcess(
-            i, c2s, s2c, game=game, n_envs=min(per, n_envs - i * per)
+            i, c2s, s2c, game=game, n_envs=min(per, n_envs - i * per),
+            wire=wire,
         )
         for i in range((n_envs + per - 1) // per)
     ]
@@ -210,15 +290,52 @@ def bench_zmq_plane(
     for p in procs:
         p.start()
     try:
-        # warmup until the pipeline flows, then count datapoints for `seconds`
-        for _ in range(512):
+        # warmup until the pipeline flows, then count datapoints over
+        # best-of-N windows (the sandbox scheduler intermittently starves
+        # a window the way the TPU tunnel does for bench_fused — a slow
+        # window is scheduler noise, not plane rate). First-datapoint
+        # timeout is generous: spawning the server fleet re-imports
+        # numpy/zmq per process and takes minutes under load
+        # (tests/test_native_env.py saw the same)
+        master.queue.get(timeout=300)
+        for _ in range(warmup_datapoints - 1):
             master.queue.get(timeout=60)
-        t0 = time.perf_counter()
-        n = 0
-        while time.perf_counter() - t0 < seconds:
-            master.queue.get(timeout=60)
-            n += 1
-        dt = time.perf_counter() - t0
+        window_rates = []
+        q = master.queue
+        for _ in range(max(1, windows)):
+            t0 = time.perf_counter()
+            deadline = t0 + seconds
+            n = 0
+            empty_since = None
+            # drain in BURSTS (get_nowait + short sleeps) rather than
+            # blocking get() per item: a consumer parked in the queue's
+            # condition variable makes every producer put() pay a futex
+            # wake — tens of us of syscall on sandboxed kernels, which at
+            # 40k datapoints/s would dominate the measurement. A real
+            # learner feed drains in batch-sized gulps for the same reason.
+            while True:
+                now = time.perf_counter()
+                if now >= deadline:
+                    break
+                try:
+                    q.get_nowait()
+                    n += 1
+                    empty_since = None
+                except queue.Empty:
+                    if empty_since is None:
+                        empty_since = now
+                    elif now - empty_since > min(5.0, seconds / 2):
+                        # must be REACHABLE inside one window (< seconds),
+                        # else the deadline expires first and a wedged wire
+                        # silently publishes a near-zero rate instead of
+                        # failing; post-warmup the plane is never quiet for
+                        # a full half-window unless something died
+                        raise RuntimeError(
+                            f"plane stalled: {min(5.0, seconds / 2):.1f}s "
+                            "without data post-warmup"
+                        )
+                    time.sleep(0.002)
+            window_rates.append(n / (time.perf_counter() - t0))
     finally:
         for p in procs:
             p.terminate()
@@ -227,7 +344,7 @@ def bench_zmq_plane(
         predictor.join(timeout=5)
         for p in procs:
             p.join(timeout=5)
-    rate = n / dt
+    rate = max(window_rates)
     kind = "nodevice" if null_device else "tpu"
     return {
         # the null-predictor ceiling must be UNMISTAKABLE from a real plane
@@ -237,8 +354,11 @@ def bench_zmq_plane(
         "unit": "env-steps/sec/host",
         "vs_baseline": round(rate / BASELINE_ENV_STEPS_PER_SEC, 3),
         "predictor": "null-host-random" if null_device else "batched-tpu",
+        "wire": wire,
         "n_envs": n_envs,
+        "envs_per_proc": per,
         "seconds": seconds,
+        "window_rates": [round(r, 1) for r in window_rates],
     }
 
 
@@ -254,6 +374,17 @@ def main():
         "zmq = host actor plane via C++ env servers; "
         "zmq-null = same plane with a no-device null predictor (the "
         "serialization+transport+batching ceiling, PERF.md)",
+    )
+    ap.add_argument(
+        "--wire",
+        default="auto",
+        choices=["auto", "block-shm", "block", "per-env"],
+        help="env-server wire protocol for the zmq planes (the fused plane "
+        "has no wire): block-shm = control over zmq + obs through a "
+        "/dev/shm ring (the README headline wire), block = all-zmq "
+        "zero-copy multipart, per-env = the pre-block compat baseline; "
+        "auto = block-shm when /dev/shm is available, else block (same "
+        "resolution as cli.py --wire)",
     )
     ap.add_argument(
         "--tpu_lock",
@@ -277,10 +408,14 @@ def main():
         mode=args.tpu_lock,
         timeout_s=float(os.environ.get("BA3C_TPU_LOCK_TIMEOUT", "1800")),
     )
+    if args.wire == "auto":
+        from distributed_ba3c_tpu.utils import shm
+
+        args.wire = "block-shm" if shm.available() else "block"
     if args.plane == "zmq":
-        print(json.dumps(bench_zmq_plane()))
+        print(json.dumps(bench_zmq_plane(wire=args.wire)))
     elif args.plane == "zmq-null":
-        print(json.dumps(bench_zmq_plane(null_device=True)))
+        print(json.dumps(bench_zmq_plane(null_device=True, wire=args.wire)))
     else:
         print(json.dumps(bench_fused()))
 

@@ -25,6 +25,7 @@ import time
 from abc import abstractmethod
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
 import zmq
 
 from distributed_ba3c_tpu.envs.base import RLEnvironment
@@ -33,7 +34,7 @@ from distributed_ba3c_tpu.utils.concurrency import (
     StoppableThread,
     queue_put_stoppable,
 )
-from distributed_ba3c_tpu.utils.serialize import dumps, loads
+from distributed_ba3c_tpu.utils.serialize import dumps, loads, unpack_block
 
 
 class TransitionExperience:
@@ -63,6 +64,106 @@ class ClientState:
         # clock: an NTP step/suspend would otherwise mass-expire (or
         # immortalize) every actor at once (ba3clint A4 caught this).
         self.last_seen = time.monotonic()
+
+
+class BlockStep:
+    """One lockstep block transition: B states with their chosen actions and
+    the rewards/dones that arrive one step later (block wire analogue of
+    :class:`TransitionExperience`, but [B]-vectorized)."""
+
+    __slots__ = ("states", "actions", "values", "logps", "rewards", "dones")
+
+    def __init__(self, states, actions, values, logps):
+        self.states = states      # [B, H, W, hist] u8 (view over the frame)
+        self.actions = actions    # [B] i32
+        self.values = values      # [B] f32
+        self.logps = logps        # [B] f32
+        self.rewards = None       # [B] f32, attached by the NEXT message
+        self.dones = None         # [B] bool, attached by the NEXT message
+
+
+class BlockStatesView:
+    """Lazy channel-last ``[B, H, W, hist]`` states over a shm ring window.
+
+    The block-shm wire ships only the NEWEST obs plane per step; the master
+    rebuilds each step's stacked state from ``hist`` consecutive ring slots
+    — as views, never as copies, on the hot path. Materialization (the one
+    unavoidable channel interleave) happens only where the bytes are
+    actually consumed: ``__array__`` for a device dispatch, ``__getitem__``
+    per datapoint at the feed's collate.
+
+    ``ages[j]`` = env j's steps since episode reset at THIS step. Envs
+    younger than ``hist-1`` have missing history planes, which
+    HistoryFramePlayer semantics define as zero — those rows take a small
+    copy-and-zero path; everything else stays a view. The window view stays
+    valid until the ring wraps onto its slots, which the master's attach-
+    time capacity check makes unreachable while consumers keep draining
+    (utils/shm.py safety contract).
+    """
+
+    __slots__ = ("window", "ages", "shape")
+
+    def __init__(self, window: np.ndarray, ages: np.ndarray):
+        self.window = window  # [hist, B, H, W] (ring view, or small copy)
+        self.ages = ages      # [B] i64 snapshot for this step
+        hist, b, h, w = window.shape
+        self.shape = (b, h, w, hist)
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    def __array__(self, dtype=None, copy=None):
+        hist = self.window.shape[0]
+        out = np.ascontiguousarray(self.window.transpose(1, 2, 3, 0))
+        for j in np.nonzero(self.ages < hist - 1)[0]:
+            out[j, :, :, : hist - 1 - int(self.ages[j])] = 0
+        if dtype is not None and dtype != out.dtype:
+            out = out.astype(dtype)
+        return out
+
+    def __getitem__(self, j: int) -> np.ndarray:
+        hist = self.window.shape[0]
+        age = int(self.ages[j])
+        if age >= hist - 1:
+            return self.window[:, j].transpose(1, 2, 0)  # zero-copy view
+        arr = np.ascontiguousarray(self.window[:, j].transpose(1, 2, 0))
+        arr[..., : hist - 1 - age] = 0
+        return arr
+
+
+class BlockClientState:
+    """Per-BLOCK state: one env-server process = one wire client = B envs.
+
+    Heartbeat/prune happen at this granularity (one ``last_seen`` per
+    block — a server is alive or dead as a unit), while the experience
+    buffers stay per-env: ``steps`` is the block's shared lockstep history
+    and ``start[j]`` indexes each env's first unflushed transition in it
+    (envs desynchronize only at episode boundaries / n-step truncations).
+    ``ring``/``ages`` are used only by the block-shm wire.
+    """
+
+    __slots__ = (
+        "ident", "n_envs", "scores", "steps", "start", "last_seen",
+        "ring", "ages", "last_step",
+    )
+
+    def __init__(self, ident: bytes, n_envs: int):
+        self.ident = ident
+        self.n_envs = n_envs
+        self.scores = np.zeros(n_envs, np.float64)  # RAW episode scores
+        self.steps: List[BlockStep] = []
+        self.start = np.zeros(n_envs, np.int64)
+        self.last_seen = time.monotonic()
+        self.ring = None  # utils.shm.ShmRing once attached (block-shm wire)
+        self.ages = np.full(n_envs, -1, np.int64)  # -1: first state pending
+        # newest wire step seen; a step that goes BACKWARDS means the server
+        # restarted under this ident (master resets the incarnation)
+        self.last_step = -1
+
+    def close(self) -> None:
+        if self.ring is not None:
+            self.ring.close()
+            self.ring = None
 
 
 def default_pipes(name: str = "ba3c") -> tuple[str, str]:
@@ -174,6 +275,11 @@ class SimulatorMaster(threading.Thread):
             queue.Queue(maxsize=1024), name="SimulatorMaster.send_queue"
         )
         self._stop_evt = threading.Event()
+        # block-shm ring sizing inputs (read by _shm_states' attach-time
+        # safety check): whoever wires a downstream batcher must declare its
+        # collate-holder capacity here — those items left the queue but
+        # still pin ring views until collate's np.stack copies them
+        self.feed_batch = 0
 
         def send_loop():
             t = threading.current_thread()
@@ -210,11 +316,20 @@ class SimulatorMaster(threading.Thread):
                 self._prune_dead_actors()
                 if not poller.poll(timeout=200):
                     continue
-                ident, state, reward, is_over = loads(self.c2s_socket.recv())
-                client = self.clients[ident]
-                client.ident = ident
-                client.last_seen = time.monotonic()
-                self._on_message(ident, state, reward, is_over)
+                # wire autodetect per message: the per-env protocol is ONE
+                # msgpack frame, the block protocol is multipart — so block
+                # and per-env speakers can share the same pipe pair (mixed
+                # fleets, rolling upgrades). copy=False: the payload frames
+                # back the numpy views directly (zero-copy ingest).
+                frames = self.c2s_socket.recv_multipart(copy=False)
+                if len(frames) == 1:
+                    ident, state, reward, is_over = loads(frames[0].buffer)
+                    client = self.clients[ident]
+                    client.ident = ident
+                    client.last_seen = time.monotonic()
+                    self._on_message(ident, state, reward, is_over)
+                else:
+                    self._on_block_frames(frames)
         except zmq.ContextTerminated:
             logger.info("SimulatorMaster context terminated")
         except zmq.ZMQError:
@@ -239,7 +354,10 @@ class SimulatorMaster(threading.Thread):
             if now - c.last_seen > self.actor_timeout
         ]
         for ident in dead:
+            client = self.clients[ident]
             del self.clients[ident]
+            if isinstance(client, BlockClientState):
+                client.close()  # release the shm ring mapping, if any
             logger.warn(
                 "actor %s silent for >%.0fs — dropped its client state",
                 ident,
@@ -271,8 +389,213 @@ class SimulatorMaster(threading.Thread):
         c = self.reward_clip
         return max(-c, min(c, reward)) if c else reward
 
+    def _learn_reward_block(self, rewards: np.ndarray) -> np.ndarray:
+        """[B]-vectorized :meth:`_learn_reward` (same clip, one np op)."""
+        c = self.reward_clip
+        return np.clip(rewards, -c, c) if c else rewards
+
+    # -- block wire ingest (docs/actor_plane.md) ---------------------------
+    def _on_block_frames(self, frames) -> None:
+        """Decode one block message and dispatch the block hooks.
+
+        Two frame layouts, distinguished by frame count:
+
+        - 4 frames (``block``): ``[header, obs[hist,B,H,W] u8, rewards[B]
+          f32, dones[B] u8]``. The obs frame is consumed as a TRANSPOSED
+          VIEW ([B,H,W,hist] channel-last, what the net eats).
+        - 3 frames (``block-shm``): ``[header, rewards, dones]`` with the
+          header naming a /dev/shm ring + this step's slot; states become a
+          lazy :class:`BlockStatesView` over the ring window.
+
+        Neither wire ever materializes the channel interleave on the hot
+        path; the one real copy happens at device ingest (or the feed's
+        collate).
+        """
+        bufs = [f.buffer for f in frames]
+        try:
+            if len(bufs) == 4:
+                meta, (obs, rewards, dones) = unpack_block(bufs)
+            else:
+                meta, (rewards, dones) = unpack_block(bufs)
+                obs = None
+            ident, step, n_envs = bytes(meta[0]), int(meta[1]), int(meta[2])
+            if rewards.shape != (n_envs,) or dones.shape != (n_envs,):
+                raise ValueError(
+                    f"block payload shapes {rewards.shape}/{dones.shape} "
+                    f"do not match header n_envs={n_envs}"
+                )
+        except (ValueError, TypeError, IndexError) as e:
+            # wire input is untrusted: a version-mismatched fleet (or any
+            # stray sender on the bound port) must not kill the receive
+            # loop for every healthy client — skip the message. The sender,
+            # if it is a real env server, parks in recv() and gets pruned.
+            logger.error("dropping undecodable block message: %s", e)
+            return
+        blk = self.clients.get(ident)
+        if blk is not None and step <= blk.last_step:
+            # step went backwards: a crashed server was RESTARTED under the
+            # same ident inside actor_timeout. Its pre-crash state (pending
+            # steps awaiting rewards, episode ages, the old ring inode)
+            # would misalign every datapoint — drop it and start a fresh
+            # incarnation, same semantics as a prune + reconnect.
+            logger.warn(
+                "block client %s restarted (step %d after %d) — resetting "
+                "its state", ident, step, blk.last_step,
+            )
+            blk.close()
+            blk = None
+        if blk is None:
+            # structural create stays in the master thread (sanitizer-
+            # checked); the defaultdict factory would make a per-env
+            # ClientState, so block entries are created explicitly
+            blk = BlockClientState(ident, n_envs)
+            self.clients[ident] = blk
+        blk.last_seen = time.monotonic()
+        blk.last_step = step
+        dones = dones.astype(bool)
+        try:
+            if obs is not None:
+                # [B,H,W,hist] zero-copy view
+                states = obs.transpose(1, 2, 3, 0)
+            else:
+                states = self._shm_states(blk, meta, step, dones)
+            self._on_block_message(ident, states, rewards, dones)
+        except (ValueError, NotImplementedError) as e:
+            # a misconfigured CLIENT (ring too small for this learner's
+            # buffering, or a block speaker against a per-env-only master)
+            # must not kill the receive loop for every other client: drop
+            # it — the server stays parked in its recv() — and keep serving
+            logger.error(
+                "dropping block client %s (it will get no reply and stay "
+                "blocked): %s", ident, e,
+            )
+            del self.clients[ident]
+            blk.close()
+
+    def _shm_states(self, blk, meta, step: int, dones: np.ndarray):
+        """Build the step's lazy states view from the client's shm ring."""
+        _, _, n_envs, ring_name, cap, h, w, hist = meta
+        if blk.ring is None:
+            from distributed_ba3c_tpu.utils.shm import ShmRing, min_safe_cap
+
+            # safety contract (utils/shm.py): a datapoint's backing slot
+            # must not be reusable while the datapoint can still be alive.
+            # A full train queue blocks the master -> action replies stop
+            # -> every lockstep server halts within one step, so the live
+            # window is bounded by queue depth + the flush horizon.
+            q = getattr(self, "queue", None)
+            maxsize = getattr(q, "maxsize", 0)
+            horizon = int(
+                getattr(self, "local_time_max", 0)
+                or getattr(self, "unroll_len", 0)
+            )
+            if maxsize <= 0:
+                raise ValueError(
+                    "block-shm wire needs a BOUNDED train queue: queue "
+                    "backpressure is what stops ring slots from being "
+                    "overwritten under live datapoints"
+                )
+            # the live window counts EVERY queued-or-held item that can pin
+            # a ring view, in ring STEPS: queue items plus the downstream
+            # feed's collate holder (outside the queue, still views), each
+            # spanning ring_steps_per_item steps (1 for BA3C datapoints;
+            # unroll_len for V-trace segments, whose bootstrap_state view
+            # trails the segment head by a whole unroll), plus the unflushed
+            # blk.steps horizon and the hist slots a window reaches back —
+            # the one formula lives in utils/shm.py, shared with cli.py's
+            # ring sizing
+            span = int(getattr(self, "ring_steps_per_item", 1))
+            feed = int(getattr(self, "feed_batch", 0))
+            needed = min_safe_cap(n_envs, maxsize, feed, span, horizon, hist)
+            if cap <= needed:
+                raise ValueError(
+                    f"shm ring cap {cap} too small for train queue "
+                    f"maxsize {maxsize} (+{feed} feed holder) x {span} "
+                    f"steps/item at B={n_envs} (+{horizon}-step flush "
+                    f"horizon): need > {needed:.0f} — shrink the queue or "
+                    "pass a larger shm_ring_cap to the env server"
+                )
+            blk.ring = ShmRing.attach(ring_name, cap, n_envs, h, w)
+        ring = blk.ring.arr
+        slot = step % cap
+        if step >= hist - 1 and slot >= hist - 1:
+            window = ring[slot - hist + 1 : slot + 1]  # zero-copy view
+        else:
+            # wrapped (or pre-history) window: small stack copy, ~hist/cap
+            # of steps take this path
+            window = np.stack(
+                [ring[(step - k) % cap] for k in range(hist - 1, -1, -1)]
+            )
+        ages = np.where(dones, 0, blk.ages + 1)
+        blk.ages = ages
+        return BlockStatesView(window, ages)
+
+    def _on_block_message(
+        self,
+        ident: bytes,
+        states: np.ndarray,
+        rewards: np.ndarray,
+        dones: np.ndarray,
+    ) -> None:
+        """Block analogue of :meth:`_on_message`: attach (rewards, dones) to
+        the previous block step, account episode scores, fire the subclass
+        flush hook, then request actions for the fresh states. Per-block
+        ordering is protocol-serialized exactly like the per-env wire: the
+        server blocks on its action reply, so no second message from
+        ``ident`` can arrive before ``_on_block_state``'s callback ran.
+        """
+        blk = self.clients[ident]
+        if blk.steps:
+            last = blk.steps[-1]
+            last.rewards = self._learn_reward_block(rewards)
+            last.dones = dones
+            blk.scores += rewards  # scores stay RAW
+            if dones.any():
+                score_q = getattr(self, "score_queue", None)
+                for j in np.nonzero(dones)[0]:
+                    if score_q is not None:
+                        try:
+                            score_q.put_nowait(float(blk.scores[j]))
+                        except queue.Full:
+                            pass
+                blk.scores[dones] = 0.0
+            self._on_block_flush(ident)
+        self._on_block_state(states, ident)
+
+    def _on_block_state(self, states: np.ndarray, ident: bytes) -> None:
+        """Fresh [B,...] states arrived: request B actions in ONE predictor
+        call and record the block transition (subclass hook)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement the block wire — "
+            "run its env servers with wire='per-env'"
+        )
+
+    def _on_block_flush(self, ident: bytes) -> None:
+        """Rewards/dones were attached to the newest block step: emit any
+        completed experience (n-step windows / unroll segments) per env
+        (subclass hook)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement the block wire — "
+            "run its env servers with wire='per-env'"
+        )
+
+    def _drop_flushed_prefix(self, blk: BlockClientState) -> None:
+        """Free block steps every env has consumed (and their zmq frames)."""
+        m = int(blk.start.min())
+        if m:
+            del blk.steps[:m]
+            blk.start -= m
+
     def send_action(self, ident: bytes, action: int) -> None:
         self._put_stoppable(self.send_queue, [ident, dumps(int(action))])
+
+    def send_block_actions(self, ident: bytes, actions: np.ndarray) -> None:
+        """One batched action reply for a whole block: raw int32[B] frame
+        (the server ``np.frombuffer``s it — no msgpack on the reply side)."""
+        self._put_stoppable(
+            self.send_queue,
+            [ident, np.ascontiguousarray(actions, np.int32).tobytes()],
+        )
 
     def _put_stoppable(self, q: queue.Queue, item, timeout: float = 0.5) -> bool:
         """Backpressure that stays shutdown-responsive: bounded-timeout puts
@@ -300,6 +623,9 @@ class SimulatorMaster(threading.Thread):
             self.context.destroy(linger=0)
         except zmq.ZMQError:
             pass  # already destroyed
+        for client in list(self.clients.values()):
+            if isinstance(client, BlockClientState):
+                client.close()  # release shm ring mappings, if any
 
     @abstractmethod
     def _on_state(self, state, ident: bytes) -> None:

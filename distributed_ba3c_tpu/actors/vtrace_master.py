@@ -19,9 +19,14 @@ from typing import Optional
 
 import numpy as np
 
-from distributed_ba3c_tpu.actors.simulator import SimulatorMaster
+from distributed_ba3c_tpu.actors.simulator import (
+    BlockClientState,
+    BlockStep,
+    SimulatorMaster,
+)
 from distributed_ba3c_tpu.predict.server import BatchedPredictor
 from distributed_ba3c_tpu.utils import sanitizer
+from distributed_ba3c_tpu.utils.concurrency import FastQueue
 
 
 class _Step:
@@ -59,8 +64,14 @@ class VTraceSimulatorMaster(SimulatorMaster):
         )
         self.predictor = predictor
         self.unroll_len = unroll_len
+        # each queued segment's bootstrap_state pins a block-shm ring view
+        # that trails the newest written slot by a whole unroll — the ring
+        # safety check must count T steps per queued item, not 1
+        self.ring_steps_per_item = unroll_len
+        # FastQueue for the same reason as BA3CSimulatorMaster: segment
+        # emission rides the block wire's datapoint budget
         self.queue: queue.Queue = sanitizer.wrap_queue(
-            train_queue or queue.Queue(maxsize=1024),
+            train_queue or FastQueue(maxsize=1024),
             name="VTraceSimulatorMaster.queue",
         )
         self.score_queue = score_queue
@@ -131,3 +142,55 @@ class VTraceSimulatorMaster(SimulatorMaster):
         client.memory = rest
         # backpressure pauses actors, but must stay shutdown-responsive
         self._put_stoppable(self.queue, segment)
+
+    # -- block wire (one message per env-server per step) ------------------
+    def _on_block_state(self, states: np.ndarray, ident: bytes) -> None:
+        blk = self.clients[ident]
+
+        def cb(actions: np.ndarray, values: np.ndarray, logps: np.ndarray):
+            # safe cross-thread append: the env server is blocked awaiting
+            # this very action block, so the master cannot reslice blk.steps
+            # until send_block_actions below releases it (protocol
+            # serialization, same argument as the per-env callback)
+            blk.steps.append(  # ba3clint: disable=A3 — protocol-serialized, see above
+                BlockStep(states, actions, values, logps)
+            )
+            self.send_block_actions(ident, actions)
+
+        self.predictor.put_block_task(states, cb)
+
+    def _on_block_flush(self, ident: bytes) -> None:
+        """Per-env unroll emission (block analogue of :meth:`_maybe_emit`).
+
+        Unrolls run straight across episode boundaries, so in block mode
+        every env emits at the same lockstep tick — but the loop stays
+        per-env and pointer-driven (``blk.start``) so the semantics hold
+        even if a subclass ever desynchronizes envs.
+        """
+        blk: BlockClientState = self.clients[ident]
+        T = self.unroll_len
+        t_end = len(blk.steps)
+        for j in range(blk.n_envs):
+            while t_end - blk.start[j] >= T + 1:
+                s = int(blk.start[j])
+                seg = blk.steps[s : s + T]
+                segment = {
+                    "state": np.stack([st.states[j] for st in seg]),
+                    "action": np.asarray(
+                        [st.actions[j] for st in seg], np.int32
+                    ),
+                    "reward": np.asarray(
+                        [st.rewards[j] for st in seg], np.float32
+                    ),
+                    "done": np.asarray(
+                        [st.dones[j] for st in seg], np.float32
+                    ),
+                    "behavior_log_probs": np.asarray(
+                        [st.logps[j] for st in seg], np.float32
+                    ),
+                    # the (T+1)-th step's state: bootstrap AND next head
+                    "bootstrap_state": blk.steps[s + T].states[j],
+                }
+                blk.start[j] = s + T
+                self._put_stoppable(self.queue, segment)
+        self._drop_flushed_prefix(blk)

@@ -45,6 +45,20 @@ def make_parser() -> argparse.ArgumentParser:
     # -- run mode ----------------------------------------------------------
     p.add_argument("--task", default="train", choices=["train", "eval", "play"])
     p.add_argument("--env", default="fake", help="fake | jax:<name> (on-device env, e.g. jax:pong) | cpp:<name> (native batched core) | gym:<name> (gymnasium adapter) | zmq:<game> (REMOTE env-server fleets play <game> and connect to --pipe_c2s/--pipe_s2c; no local simulators)")
+    p.add_argument(
+        "--wire",
+        default="auto",
+        choices=["auto", "block-shm", "block", "per-env"],
+        help="actor-plane wire protocol for batched env servers (cpp:*): "
+        "block-shm = tiny control messages + obs through a /dev/shm ring "
+        "(same-host, fastest); block = one zero-copy multipart message per "
+        "server per step (the tcp:// remote-fleet wire); per-env = B "
+        "separate msgpack messages per step (reference-compatible compat "
+        "foil); auto = block-shm when /dev/shm is available, else block "
+        "(docs/actor_plane.md). The master autodetects per message, so "
+        "mixed fleets work; per-process simulators (fake/gym:/jax:) always "
+        "speak per-env",
+    )
     p.add_argument("--load", default=None, help="checkpoint dir to resume from")
     p.add_argument("--logdir", default="train_log/ba3c")
     # -- hyperparams (reference argparse defaults, SURVEY.md §2.9) ---------
@@ -387,6 +401,8 @@ def main(argv: Optional[list] = None) -> int:
         n_seg = max(n_data, (n_seg // n_data) * n_data)
         assert n_seg % n_hosts == 0, (n_seg, n_hosts)
         feed = RolloutFeed(master.queue, n_seg // n_hosts)
+        # ring-safety input: the feed's collate holder pins ring views too
+        master.feed_batch = n_seg // n_hosts
         samples_per_step = n_seg * cfg.local_time_max
     else:
         step = make_train_step(model, optimizer, cfg, mesh)
@@ -403,6 +419,8 @@ def main(argv: Optional[list] = None) -> int:
         if distributed:
             local_batch_slice(cfg.batch_size)  # asserts host divisibility
         feed = TrainFeed(master.queue, cfg.batch_size // n_hosts)
+        # ring-safety input: the feed's collate holder pins ring views too
+        master.feed_batch = cfg.batch_size // n_hosts
         samples_per_step = cfg.batch_size
     if external_fleet:
         # remote fleets own the envs; nothing to start locally
@@ -416,8 +434,50 @@ def main(argv: Optional[list] = None) -> int:
         from distributed_ba3c_tpu.envs import native
 
         game = args.env.split(":", 1)[1]
+        wire = args.wire
+        if wire == "auto":
+            from distributed_ba3c_tpu.utils import shm
+
+            wire = "block-shm" if shm.available() else "block"
         total = cfg.simulator_procs
         per = min(16, total)
+        if wire != "per-env" and per > cfg.predict_batch_size:
+            # fail at startup, not as an exception inside the master's
+            # receive loop mid-run: a block must fit the serving bucket
+            raise SystemExit(
+                f"--predict_batch_size {cfg.predict_batch_size} is smaller "
+                f"than the env-server block size {per}: the block wire "
+                "serves a whole block in one predictor call — raise "
+                f"--predict_batch_size to >= {per} or use --wire per-env"
+            )
+        def ring_cap(b: int):
+            # size each server's shm ring for THIS run's actual buffering
+            # (queue + feed holder + flush horizon) so the master's check
+            # never refuses a config the defaults could have sized for;
+            # 25% headroom. Every input is read off the master object and
+            # fed to the SAME utils/shm.py formula the master's attach-time
+            # check uses — sizing and refusal cannot drift
+            if wire != "block-shm":
+                return None
+            from distributed_ba3c_tpu.utils.shm import min_safe_cap
+
+            need = min_safe_cap(
+                b,
+                int(getattr(master.queue, "maxsize", 0)),
+                int(getattr(master, "feed_batch", 0)),
+                int(getattr(master, "ring_steps_per_item", 1)),
+                int(
+                    getattr(master, "local_time_max", 0)
+                    or getattr(master, "unroll_len", 0)
+                ),
+                cfg.frame_history,
+            )
+            return max(
+                native.CppEnvServerProcess.SHM_RING_MIN_CAP,
+                native.CppEnvServerProcess.SHM_RING_STEPS // max(1, b),
+                int(need * 1.25) + 1,
+            )
+
         procs = [
             native.CppEnvServerProcess(
                 i,
@@ -426,6 +486,8 @@ def main(argv: Optional[list] = None) -> int:
                 game=game,
                 n_envs=min(per, total - i * per),
                 frame_history=cfg.frame_history,
+                wire=wire,
+                shm_ring_cap=ring_cap(min(per, total - i * per)),
             )
             for i in range((total + per - 1) // per)
         ]

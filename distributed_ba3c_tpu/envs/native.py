@@ -177,12 +177,41 @@ def build_cpp_player(idx: int, name: str = "pong", frame_history: int = 4):
 class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc]
     """One process, B native envs, lockstep-batched stepping, ZMQ transport.
 
-    Each env gets its own DEALER socket with identity ``<prefix>-<i>`` so the
-    ROUTER-side master multiplexes B clients from one process. Protocol per
-    env matches SimulatorProcess exactly (SURVEY.md §3.2): send
-    [ident, stacked_state, reward, isOver], await action. Frame-history
-    stacking happens here (numpy ring buffer), matching HistoryFramePlayer.
+    Three wire modes (docs/actor_plane.md):
+
+    - ``wire="block-shm"`` (default where available): control over ZMQ,
+      observation bytes through a /dev/shm ring (utils/shm.py). ONE tiny
+      multipart message per STEP — ``[header, rewards[B], dones[B]]``,
+      where the header names the ring and the step's slot — and one raw
+      ``int32[B]`` action reply. The obs bytes never cross a socket: the
+      server memcpys each step's plane into ``ring[step % cap]`` and the
+      master reads frame-history windows as numpy views. Same-host only
+      (the learner's ipc:// or localhost pipes).
+    - ``wire="block"``: ONE multipart message per STEP for the whole block
+      — ``[header, obs[hist,B,H,W], rewards[B], dones[B]]`` as raw
+      zero-copy frames — and one raw ``int32[B]`` action reply, routed by
+      the block's single DEALER identity ``<prefix>*block``. The history
+      stack lives in ``[hist, B, H, W]`` layout so the per-step shift is a
+      contiguous memmove (~78 us/block vs ~4 ms for the channel-last shift
+      at B=32 — measured on this container) and the wire frame is the
+      buffer itself; the master consumes transposed VIEWS, so no side of
+      the hot path ever materializes the channel-last interleave. This is
+      the wire for REMOTE (tcp://) actor fleets.
+    - ``wire="per-env"``: the compat/correctness foil — each env gets its
+      own DEALER identity ``<prefix>-<i>`` and the per-env msgpack protocol
+      matches SimulatorProcess exactly (SURVEY.md §3.2): send
+      [ident, stacked_state, reward, isOver], await action. 2·B Python
+      socket ops + B msgpack encodes per step; kept because any
+      wire-compatible speaker (the reference's own simulators) can
+      interleave with it on the same pipes.
     """
+
+    #: default block-shm ring sizing: capacity (in steps) chosen so the
+    #: ring is ~8192 env-steps deep regardless of B (~57 MB at 84x84),
+    #: which keeps the master's attach-time safety check satisfied for
+    #: train queues up to ~8k items at any block size (utils/shm.py)
+    SHM_RING_STEPS = 8192
+    SHM_RING_MIN_CAP = 64
 
     def __init__(
         self,
@@ -193,8 +222,11 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
         n_envs: int = 16,
         frame_history: int = 4,
         ident_prefix: Optional[str] = None,
+        wire: str = "block",
+        shm_ring_cap: Optional[int] = None,
     ):
         super().__init__(daemon=True, name=f"cpp-env-server-{idx}")
+        assert wire in ("block-shm", "block", "per-env"), wire
         self.idx = idx
         self.c2s = pipe_c2s
         self.s2c = pipe_s2c
@@ -202,8 +234,147 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
         self.n_envs = n_envs
         self.frame_history = frame_history
         self.ident_prefix = ident_prefix or f"cppsim-{idx}"
+        self.wire = wire
+        self.shm_ring_cap = shm_ring_cap or max(
+            self.SHM_RING_MIN_CAP, self.SHM_RING_STEPS // max(1, n_envs)
+        )
 
     def run(self) -> None:  # child process: no jax
+        if self.wire == "block-shm":
+            self._run_block_shm()
+        elif self.wire == "block":
+            self._run_block()
+        else:
+            self._run_per_env()
+
+    def _run_block_shm(self) -> None:
+        import signal
+
+        import zmq
+
+        from distributed_ba3c_tpu.utils.serialize import pack_block
+        from distributed_ba3c_tpu.utils.shm import ShmRing
+
+        # terminate() must run the finally block so the ring file is
+        # unlinked (a SIGKILLed server's stale file is truncated over at
+        # the next create)
+        def _term(*_):
+            raise SystemExit(0)
+
+        signal.signal(signal.SIGTERM, _term)
+
+        env = CppBatchedEnv(self.game, self.n_envs, seed=self.idx * 10_000)
+        obs = env.reset()
+        B, H, W, hist = self.n_envs, env.h, env.w, self.frame_history
+        cap = self.shm_ring_cap
+        ident = f"{self.ident_prefix}*block".encode()
+        # the ring name must be STABLE across restarts of this server slot
+        # (pipe pair + prefix identify the slot; concurrent fleets differ in
+        # pipe address): a crashed/SIGKILLed server leaves its ring file in
+        # /dev/shm, and create()'s rename-over reclaims it only if the
+        # replacement generates the SAME name — a pid in the name would
+        # leak ~57 MB per crash until /dev/shm fills (utils/shm.py)
+        import hashlib
+
+        fleet = hashlib.sha1(self.c2s.encode()).hexdigest()[:8]
+        ring_name = f"ba3c-ring-{fleet}-{self.ident_prefix}"
+        ring = ShmRing.create(ring_name, cap, B, H, W)
+        rewards = np.zeros(B, np.float32)
+        dones = np.zeros(B, np.uint8)
+
+        ctx = zmq.Context()
+        push = ctx.socket(zmq.PUSH)
+        push.set_hwm(4)
+        push.connect(self.c2s)
+        dealer = ctx.socket(zmq.DEALER)
+        dealer.setsockopt(zmq.IDENTITY, ident)
+        dealer.connect(self.s2c)
+
+        step = 0
+        try:
+            while True:
+                # the step's obs plane goes into the ring; the wire carries
+                # only the header + rewards + dones (the master rebuilds
+                # frame-history windows from ring slots — docs/actor_plane.md)
+                ring.arr[step % cap] = obs
+                push.send_multipart(
+                    pack_block(
+                        [ident, step, B, ring_name, cap, H, W, hist],
+                        [rewards, dones],
+                    ),
+                    copy=False,
+                )
+                actions = np.frombuffer(dealer.recv(), np.int32)
+                obs, rew, dn = env.step(actions)
+                rewards[:] = rew
+                dones[:] = dn
+                step += 1
+        except (KeyboardInterrupt, SystemExit, zmq.ContextTerminated):
+            pass
+        finally:
+            dealer.close(0)
+            push.close(0)
+            ctx.term()
+            ring.close(unlink=True)
+
+    def _run_block(self) -> None:
+        import zmq
+
+        from distributed_ba3c_tpu.utils.serialize import pack_block
+
+        env = CppBatchedEnv(self.game, self.n_envs, seed=self.idx * 10_000)
+        obs = env.reset()
+        B, H, W, hist = self.n_envs, env.h, env.w, self.frame_history
+        # [hist, B, H, W]: oldest..newest planes, contiguous — the shift is
+        # one contiguous memmove and the whole stack is ONE wire frame
+        stacks = np.zeros((hist, B, H, W), np.uint8)
+        stacks[-1] = obs
+        rewards = np.zeros(B, np.float32)
+        dones = np.zeros(B, np.uint8)
+        ident = f"{self.ident_prefix}*block".encode()
+
+        ctx = zmq.Context()
+        push = ctx.socket(zmq.PUSH)
+        push.set_hwm(4)  # blocks are big; a deep send buffer is pure RAM
+        push.connect(self.c2s)
+        dealer = ctx.socket(zmq.DEALER)
+        dealer.setsockopt(zmq.IDENTITY, ident)
+        dealer.connect(self.s2c)
+
+        step = 0
+        try:
+            while True:
+                # copy=False hands zmq the arrays' own buffers. Safe ONLY
+                # because the protocol is lockstep: the master cannot reply
+                # with actions before it has received (= fully copied out of
+                # this process over ipc/tcp) the observation message, and we
+                # do not mutate the buffers until that reply arrives.
+                push.send_multipart(
+                    pack_block(
+                        [ident, step, B], [stacks, rewards, dones]
+                    ),
+                    copy=False,
+                )
+                actions = np.frombuffer(dealer.recv(), np.int32)
+                obs, rew, dn = env.step(actions)
+                rewards[:] = rew
+                dones[:] = dn
+                # shift history (contiguous memmove); clear across episode
+                # boundaries so the first post-reset state is [0,...,0,obs]
+                stacks[:-1] = stacks[1:]
+                stacks[-1] = obs
+                if dn.any():
+                    d = dn.astype(bool)
+                    stacks[:-1, d] = 0
+                step += 1
+        except (KeyboardInterrupt, zmq.ContextTerminated):
+            pass
+        finally:
+            dealer.close(0)
+            push.close(0)
+            ctx.term()
+
+    def _run_per_env(self) -> None:
         import zmq
 
         from distributed_ba3c_tpu.utils.serialize import dumps, loads
@@ -231,12 +402,15 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
         actions = np.zeros(B, np.int32)
         try:
             while True:
+                # the per-env wire IS the A6 antipattern — kept on purpose
+                # as the compat/correctness foil (`--wire per-env`); the
+                # block path above is the production wire
                 for i in range(B):
-                    push.send(
+                    push.send(  # ba3clint: disable=A6 — compat foil, see docstring
                         dumps([idents[i], stacks[i], float(rewards[i]), bool(dones[i])])
                     )
                 for i in range(B):
-                    actions[i] = loads(dealers[i].recv())
+                    actions[i] = loads(dealers[i].recv())  # ba3clint: disable=A6 — compat foil
                 obs, rew, dn = env.step(actions)
                 rewards[:] = rew
                 dones[:] = dn.astype(bool)

@@ -38,6 +38,22 @@ def _next_pow2(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
+class _BlockTask:
+    """One whole [B, ...] state block awaiting ONE batched callback.
+
+    The block wire's unit of work: B states that arrived as one message and
+    leave as one ``int32[B]`` action reply — no per-row splitting, no
+    per-row Python bookkeeping anywhere between the socket and the device.
+    """
+
+    __slots__ = ("states", "callback", "k")
+
+    def __init__(self, states, callback):
+        self.states = states
+        self.callback = callback
+        self.k = states.shape[0]
+
+
 def make_fwd_sample(model, greedy: bool = False) -> Callable:
     """The action server's compiled program: forward + on-device sampling.
 
@@ -167,6 +183,29 @@ class BatchedPredictor:
         queue) are dropped — their simulators are being torn down too."""
         queue_put_stoppable(self._queue, (state, callback), self._stop_evt)
 
+    def put_block_task(
+        self,
+        states: np.ndarray,
+        callback: Callable[[np.ndarray, np.ndarray, np.ndarray], None],
+    ) -> None:
+        """Queue one [B, ...] state block (the block wire's whole batch);
+        ``callback(actions[B], values[B], logps[B])`` fires ONCE when the
+        block is served. The block lands in a warmed pow-2 bucket as a
+        unit — no per-row splitting; when ``coalesce_ms`` allows, several
+        queued blocks share one device call (weighted coalescing in
+        :meth:`_fetch_batch`). Same drop-on-stop semantics as
+        :meth:`put_task`."""
+        cap = _next_pow2(max(self._batch_size, 1))
+        if states.shape[0] > cap:
+            raise ValueError(
+                f"block of {states.shape[0]} states exceeds the serving "
+                f"bucket ({cap}) — raise predict_batch_size to at least "
+                "the env-server block size"
+            )
+        queue_put_stoppable(
+            self._queue, _BlockTask(states, callback), self._stop_evt
+        )
+
     def predict_batch(
         self, states: np.ndarray
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -174,30 +213,9 @@ class BatchedPredictor:
 
         ``actions`` follow the serving policy (sampled, or argmax when
         ``greedy=True``); ``greedy_actions`` are always the argmax — the
-        Evaluator consumes those without a second device call. Inputs
-        larger than the serving bucket (an Evaluator with more envs than
-        ``batch_size``) are chunked to it, so no bucket beyond warmup's is
-        ever compiled — bounded device memory, and no post-warmup retrace
-        for the BA3C_AUDIT=1 tripwire to refuse."""
-        states = np.asarray(states)
-        cap = _next_pow2(max(self._batch_size, 1))
-        if states.shape[0] <= cap:
-            actions, values, _, greedy_actions = self._run_device(states)
-            return actions, values, greedy_actions
-        # dispatch EVERY chunk before fetching any: jax dispatch is async,
-        # so the chunks' compute overlaps while fetches (the ~135 ms/array
-        # latency documented above) drain in order — fetching inside the
-        # dispatch loop would serialize compute behind readback. Snapshot
-        # params once: a learner publish between chunks must not split one
-        # logical batch across two policies.
-        params = self._params
-        pending = [
-            self._dispatch(params, states[i:i + cap])
-            for i in range(0, states.shape[0], cap)
-        ]
-        parts = [self._unpack(np.asarray(packed), k) for k, packed in pending]
-        actions, values, _, greedy_actions = (
-            np.concatenate(p) for p in zip(*parts)
+        Evaluator consumes those without a second device call."""
+        actions, values, _, greedy_actions = self._run_rows(
+            np.asarray(states)
         )
         return actions, values, greedy_actions
 
@@ -212,6 +230,9 @@ class BatchedPredictor:
 
         ``params`` is passed explicitly so a multi-chunk caller serves ONE
         parameter version even if the learner publishes mid-batch."""
+        # device ingest is where a lazy block-states view (block-shm wire)
+        # pays its one materialization — jit can't take a BlockStatesView
+        batch = np.asarray(batch)
         k = batch.shape[0]
         padded = _next_pow2(max(k, 1))
         if padded != k:
@@ -233,6 +254,31 @@ class BatchedPredictor:
         # ONE device->host fetch (see fwd_sample)
         return self._unpack(np.asarray(packed), k)
 
+    def _run_rows(self, states: np.ndarray):
+        """Serve N rows: (actions, values, logps, greedy_actions).
+
+        Inputs larger than the serving bucket (an Evaluator with more envs
+        than ``batch_size``, or a coalesced run of block tasks) are chunked
+        to it, so no bucket beyond warmup's is ever compiled — bounded
+        device memory, and no post-warmup retrace for the BA3C_AUDIT=1
+        tripwire to refuse. The chunked path dispatches EVERY chunk before
+        fetching any: jax dispatch is async, so the chunks' compute
+        overlaps while fetches (the ~135 ms/array latency documented above)
+        drain in order — fetching inside the dispatch loop would serialize
+        compute behind readback. Params are snapshotted once per call: a
+        learner publish mid-call must not split one logical batch across
+        two policies."""
+        cap = _next_pow2(max(self._batch_size, 1))
+        if states.shape[0] <= cap:
+            return self._run_device(states)
+        params = self._params
+        pending = [
+            self._dispatch(params, states[i:i + cap])
+            for i in range(0, states.shape[0], cap)
+        ]
+        parts = [self._unpack(np.asarray(packed), k) for k, packed in pending]
+        return tuple(np.concatenate(p) for p in zip(*parts))
+
     def _fetch_batch(self, t: StoppableThread):
         """Block for one task, then coalesce toward a full batch.
 
@@ -242,33 +288,80 @@ class BatchedPredictor:
         up to ``coalesce_ms`` to multiply the batch is a large win for the
         actor plane (measured: greedy draining served tiny batches and
         collapsed ZMQ-plane throughput). ``coalesce_ms=0`` restores the
-        reference behavior."""
+        reference behavior. Tasks are WEIGHTED: a block task counts its B
+        rows, so one ``batch_size``-sized block fills the batch alone and
+        several small blocks coalesce into one device call."""
         import time as _time
 
         first = t.queue_get_stoppable(self._queue)
         if first is None:
             return None
         tasks = [first]
+        weight = first.k if isinstance(first, _BlockTask) else 1
         deadline = _time.perf_counter() + self._coalesce_s
-        while len(tasks) < self._batch_size:
+        while weight < self._batch_size:
             remaining = deadline - _time.perf_counter()
             try:
                 if remaining > 0:
-                    tasks.append(self._queue.get(timeout=remaining))
+                    tk = self._queue.get(timeout=remaining)
                 else:
-                    tasks.append(self._queue.get_nowait())
+                    tk = self._queue.get_nowait()
             except queue.Empty:
                 break
+            tasks.append(tk)
+            weight += tk.k if isinstance(tk, _BlockTask) else 1
         return tasks
+
+    def _serve_group(self, tasks) -> None:
+        """One device call for a ≤-bucket group of tasks."""
+        singles = [tk for tk in tasks if not isinstance(tk, _BlockTask)]
+        blocks = [tk for tk in tasks if isinstance(tk, _BlockTask)]
+        rows = []
+        if singles:
+            rows.append(np.stack([s for s, _ in singles]))
+        rows.extend(b.states for b in blocks)
+        # a lone block is served AS-IS (its states stay a zero-copy view
+        # straight off the wire); mixing tasks pays one concat
+        batch = rows[0] if len(rows) == 1 else np.concatenate(
+            [np.asarray(r) for r in rows]
+        )
+        actions, values, logps, _ = self._run_device(batch)
+        off = 0
+        if singles:
+            n = len(singles)
+            for (_, cb), a, v, lp in zip(
+                singles, actions[:n], values[:n], logps[:n]
+            ):
+                cb(int(a), float(v), float(lp))
+            off = n
+        for b in blocks:
+            b.callback(
+                actions[off:off + b.k],
+                values[off:off + b.k],
+                logps[off:off + b.k],
+            )
+            off += b.k
 
     def _worker(self) -> None:
         t = threading.current_thread()
         assert isinstance(t, StoppableThread)
+        cap = _next_pow2(max(self._batch_size, 1))
         while not t.stopped():
             tasks = self._fetch_batch(t)
             if tasks is None:
                 return
-            states = np.stack([s for s, _ in tasks])
-            actions, values, logps, _ = self._run_device(states)
-            for (_, cb), a, v, lp in zip(tasks, actions, values, logps):
-                cb(int(a), float(v), float(lp))
+            # pack into groups that fit the warmed bucket: coalescing can
+            # overshoot by up to one block, and a batch beyond the bucket
+            # would compile a NEW program mid-serving (the BA3C_AUDIT
+            # tripwire refuses exactly that)
+            group: list = []
+            weight = 0
+            for tk in tasks:
+                k = tk.k if isinstance(tk, _BlockTask) else 1
+                if group and weight + k > cap:
+                    self._serve_group(group)
+                    group, weight = [], 0
+                group.append(tk)
+                weight += k
+            if group:
+                self._serve_group(group)

@@ -14,13 +14,90 @@ checks it at runtime in tests.
 from __future__ import annotations
 
 import atexit
+import collections
 import multiprocessing as mp
 import queue
 import signal
 import threading
+import time
 import weakref
 from contextlib import contextmanager
 from typing import Callable, Iterable, Optional, Union
+
+
+class FastQueue:
+    """Bounded FIFO without locks or condition variables.
+
+    ``queue.Queue`` takes a mutex and signals a condition variable on every
+    operation; under producer/consumer contention each op degrades to a
+    futex syscall. On sandboxed kernels where syscalls are expensive (this
+    container: measured 37-56 us PER PUT at plane rates — more than the
+    whole block wire's per-datapoint budget), that makes ``queue.Queue``
+    itself the actor plane's throughput ceiling (~20k items/s).
+
+    This queue uses a plain ``collections.deque`` — ``append``/``popleft``
+    are GIL-atomic, ~0.2 us — and bounded SLEEP-POLLING instead of
+    condition variables when empty/full. The trade: a few ms of wakeup
+    latency when a side actually has to wait, which is the right deal for
+    a queue that is never supposed to be empty or full in steady state
+    (the train queue at 40k+ datapoints/s).
+
+    Implements the ``queue.Queue`` subset the actor plane uses (``put``/
+    ``get`` with block/timeout, ``*_nowait``, ``qsize``/``empty``/``full``,
+    ``maxsize``). The bound is approximate under multiple producers (two
+    racing puts can overshoot by one item each) — backpressure, not an
+    exact invariant.
+    """
+
+    _POLL_S = 0.002
+
+    def __init__(self, maxsize: int = 0):
+        self.maxsize = maxsize
+        self._d: collections.deque = collections.deque()
+
+    def qsize(self) -> int:
+        return len(self._d)
+
+    def empty(self) -> bool:
+        return not self._d
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and len(self._d) >= self.maxsize
+
+    def put(self, item, block: bool = True, timeout: Optional[float] = None):
+        if self.maxsize > 0 and len(self._d) >= self.maxsize:
+            if not block:
+                raise queue.Full
+            deadline = (
+                None if timeout is None else time.monotonic() + timeout
+            )
+            while len(self._d) >= self.maxsize:
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise queue.Full
+                time.sleep(self._POLL_S)
+        self._d.append(item)
+
+    def put_nowait(self, item):
+        self.put(item, block=False)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        try:
+            return self._d.popleft()
+        except IndexError:
+            pass
+        if not block:
+            raise queue.Empty
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return self._d.popleft()
+            except IndexError:
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise queue.Empty
+                time.sleep(self._POLL_S)
+
+    def get_nowait(self):
+        return self.get(block=False)
 
 
 def queue_put_stoppable(

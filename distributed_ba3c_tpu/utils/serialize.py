@@ -6,11 +6,23 @@ msgpack_numpy ``dumps``/``loads`` used for every ZMQ payload (SURVEY.md §2.8
 msgpack ext type carrying (dtype, shape, raw bytes); uint8 frames therefore
 cross the wire at 1 byte/pixel with no base64/pickle overhead, matching the
 reference's design intent.
+
+Two codecs live here:
+
+- :func:`dumps` / :func:`loads` — ONE msgpack byte string per message (the
+  per-env wire). ``dumps`` copies every array once (``tobytes``); fine for
+  one 28 KB state per message, ruinous for a whole [B, ...] block.
+- :func:`pack_block` / :func:`unpack_block` — a MULTIPART message: one tiny
+  msgpack header frame describing metadata + array specs, then each array's
+  raw buffer as its own frame. The pack side hands zmq the arrays' own
+  memory (no ``tobytes``), the unpack side returns ``np.frombuffer`` views
+  over the received frames (no copy). This is the block wire's codec
+  (docs/actor_plane.md).
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, List, Sequence, Tuple
 
 import msgpack
 import numpy as np
@@ -49,6 +61,55 @@ def dumps(obj: Any) -> bytes:
     return msgpack.packb(obj, use_bin_type=True, default=_default)
 
 
-def loads(buf: bytes) -> Any:
-    """Inverse of :func:`dumps`. Arrays are views over the input buffer."""
+def loads(buf) -> Any:
+    """Inverse of :func:`dumps`. Arrays are views over the input buffer.
+
+    Accepts any bytes-like object (``bytes``, ``memoryview``, ``zmq.Frame``
+    buffers) so non-copying ZMQ receives decode without a round-trip through
+    ``bytes()``.
+    """
     return msgpack.unpackb(buf, raw=False, ext_hook=_ext_hook)
+
+
+def pack_block(meta: Any, arrays: Sequence[np.ndarray]) -> List[Any]:
+    """Multipart zero-copy encode: ``[header, raw_buf_0, ..., raw_buf_n]``.
+
+    ``meta`` is any msgpack-serializable object (the block wire puts the
+    sender ident + step counter here). Each array contributes one frame that
+    IS its buffer — no ``tobytes`` copy; non-contiguous inputs are made
+    contiguous first (the one copy this path ever does, and only when the
+    caller hands a strided view). The caller must not mutate the arrays
+    until the message is known to have left the process — the block wire's
+    lockstep send→await-actions structure guarantees exactly that.
+    """
+    specs: List[Tuple[str, Tuple[int, ...]]] = []
+    frames: List[Any] = [b""]  # placeholder for the header
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        specs.append((a.dtype.str, a.shape))
+        frames.append(a.data)
+    frames[0] = msgpack.packb(
+        (meta, specs), use_bin_type=True, default=_default
+    )
+    return frames
+
+
+def unpack_block(frames: Sequence[Any]) -> Tuple[Any, List[np.ndarray]]:
+    """Inverse of :func:`pack_block`: ``(meta, arrays)``.
+
+    ``frames`` are bytes-like (bytes, memoryview, or ``zmq.Frame.buffer``).
+    Every returned array is a ``frombuffer`` VIEW over its frame — zero
+    copies; the arrays keep the frames alive for as long as they are
+    referenced.
+    """
+    meta, specs = msgpack.unpackb(frames[0], raw=False, ext_hook=_ext_hook)
+    if len(specs) != len(frames) - 1:
+        raise ValueError(
+            f"block header declares {len(specs)} arrays but the message "
+            f"carries {len(frames) - 1} payload frames"
+        )
+    arrays = [
+        np.frombuffer(buf, dtype=np.dtype(dtype_str)).reshape(shape)
+        for (dtype_str, shape), buf in zip(specs, frames[1:])
+    ]
+    return meta, arrays

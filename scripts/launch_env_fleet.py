@@ -35,7 +35,23 @@ def main(argv=None) -> int:
     p.add_argument(
         "--base_idx", type=int, default=0,
         help="first server index — MUST differ across actor hosts so ZMQ "
-        "identities (cppsim-<idx>-<env>) never collide",
+        "identities (cppsim-<idx>-<env> / cppsim-<idx>*block) never collide",
+    )
+    p.add_argument(
+        "--wire", default="block", choices=["block-shm", "block", "per-env"],
+        help="block = one zero-copy multipart message per server per step "
+        "(docs/actor_plane.md, the tcp:// cross-host wire and the default "
+        "here); block-shm = obs through a /dev/shm ring — ONLY when this "
+        "fleet runs on the LEARNER's host; per-env = B msgpack messages "
+        "per step (reference-compatible compat foil)",
+    )
+    p.add_argument(
+        "--shm_ring_cap", type=int, default=None,
+        help="block-shm ring capacity in steps (default: sized for ~8192 "
+        "env-steps). The learner's master REFUSES rings smaller than its "
+        "queue+feed buffering needs (utils/shm.py safety contract) and "
+        "drops the client — size this to the learner's config when it "
+        "rejects the default",
     )
     args = p.parse_args(argv)
 
@@ -58,6 +74,8 @@ def main(argv=None) -> int:
                 game=args.game,
                 n_envs=min(per, left),
                 frame_history=args.frame_history,
+                wire=args.wire,
+                shm_ring_cap=args.shm_ring_cap,
             )
         )
         left -= per

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Actor-plane throughput instrument: the plane finally gets a PINNED number.
+
+Measures the full ZMQ experience plane — C++ batched env servers → ZMQ →
+master routing → batched predictor → n-step assembly → train queue — in two
+predictor modes and (by default) both wire protocols:
+
+- **device-free** (null predictor, host-side random actions): the plane's
+  OWN ceiling, no device and no tunnel RTT in the loop. This is the number
+  that pinned the per-env wire at 2,128 env-steps/s/host (PERF.md round 4)
+  and the one the block wire's ≥40k acceptance bar is defined on.
+- **device-in-loop** (``--device``): the same plane serving through the real
+  batched predictor on whatever device jax finds. On the dev tunnel this is
+  RTT-bound (~135 ms per fetch, PERF.md) — measured so the gap between the
+  two modes stays attributed, not asserted.
+
+Prints ONE JSON line on stdout (the repo's bench-tooling contract); per-mode
+diagnostics go to stderr. Device-free runs force ``JAX_PLATFORMS=cpu`` and
+never take the TPU-claim mutex — a plane bench must not queue behind (or
+wedge) a training run when no device is in its loop.
+
+Usage:
+  python scripts/plane_bench.py                        # device-free, both wires
+  python scripts/plane_bench.py --wires block          # device-free, block only
+  python scripts/plane_bench.py --device --tpu_lock wait   # add device-in-loop
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--game", default="pong")
+    ap.add_argument("--n_envs", type=int, default=512)
+    ap.add_argument(
+        "--envs_per_proc", type=int, default=512,
+        help="block size B: envs per server process (= envs per wire "
+        "message). Fewer, bigger blocks win on few-core hosts: the "
+        "committed capture's 1x512 beat 2x256 by ~40%% (scheduler "
+        "contention; see docs/actor_plane.md)",
+    )
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument(
+        "--wires", default="block-shm,block,per-env",
+        help="comma list of wire modes to measure "
+        "(block-shm | block | per-env)",
+    )
+    ap.add_argument(
+        "--windows", type=int, default=3,
+        help="timed windows per mode; best window wins (scheduler-noise "
+        "filter, same policy as bench_fused)",
+    )
+    ap.add_argument(
+        "--device", action="store_true",
+        help="ALSO measure device-in-loop (real predictor on whatever "
+        "device jax finds; takes the TPU-claim mutex)",
+    )
+    ap.add_argument("--tpu_lock", default="wait", choices=["wait", "fail", "off"])
+    args = ap.parse_args()
+
+    wires = [w.strip() for w in args.wires.split(",") if w.strip()]
+    for w in wires:
+        if w not in ("block-shm", "block", "per-env"):
+            raise SystemExit(f"unknown wire mode {w!r}")
+
+    if not args.device:
+        # device-free: no accelerator in the loop, so no TPU claim and no
+        # tunnel — pin the platform BEFORE jax imports (bench_zmq_plane
+        # builds params; on cpu that is milliseconds)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    else:
+        from distributed_ba3c_tpu.utils.devicelock import guard_tpu
+
+        _lock = guard_tpu(  # noqa: F841 — held for process lifetime
+            "plane_bench",
+            mode=args.tpu_lock,
+            timeout_s=float(os.environ.get("BA3C_TPU_LOCK_TIMEOUT", "1800")),
+        )
+
+    from distributed_ba3c_tpu.utils.devicelock import stderr_print
+
+    from bench import bench_zmq_plane
+
+    runs = {}
+    for wire in wires:
+        if wire == "per-env":
+            # the compat foil is measured at ITS OWN historical config
+            # (256 envs in 32-env servers — the shape PERF.md's 2,128
+            # baseline was pinned at); hundreds of DEALER sockets per
+            # process is not a shape the per-env wire ever ran at
+            n_envs, per = min(256, args.n_envs), 32
+        else:
+            n_envs, per = args.n_envs, args.envs_per_proc
+        r = bench_zmq_plane(
+            game=args.game, n_envs=n_envs, seconds=args.seconds,
+            null_device=True, wire=wire, envs_per_proc=per,
+            windows=args.windows,
+        )
+        runs[f"nodevice_{wire}"] = r
+        stderr_print(
+            f"device-free {wire:8s}: {r['value']:>10.1f} env-steps/s/host"
+        )
+        if args.device:
+            r = bench_zmq_plane(
+                game=args.game, n_envs=n_envs, seconds=args.seconds,
+                null_device=False, wire=wire,
+                envs_per_proc=per, windows=args.windows,
+            )
+            runs[f"device_{wire}"] = r
+            stderr_print(
+                f"device     {wire:8s}: {r['value']:>10.1f} env-steps/s/host"
+            )
+
+    headline = (runs.get("nodevice_block-shm")
+        or runs.get("nodevice_block") or next(iter(runs.values())))
+    out = {
+        "metric": "zmq_plane_env_steps_per_sec_per_host",
+        # the headline is the best same-host block wire's device-free
+        # rate: the plane's own ceiling here (the ISSUE-4 acceptance
+        # number)
+        "value": headline["value"],
+        "unit": "env-steps/sec/host",
+        "game": args.game,
+        "n_envs": args.n_envs,
+        "envs_per_proc": args.envs_per_proc,
+        "seconds": args.seconds,
+        "runs": runs,
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,22 @@
+"""A6 clean: the block wire and the loop shapes that are NOT per-env ops."""
+
+SNDMORE = 2
+
+
+def serve_block(n_envs, push, dealer, frames, rewards):
+    # the block wire: ONE multipart send + ONE batched reply for all B envs
+    push.send_multipart(frames, copy=False)
+    reply = dealer.recv_multipart()
+    # chunking the FRAMES of one logical message is not a per-env loop
+    for frame in frames:
+        push.send(frame, flags=SNDMORE)
+    # compute-only loops over env indices are fine
+    total = 0.0
+    for i in range(n_envs):
+        total += rewards[i]
+    return reply, total
+
+
+def shutdown(dealers):
+    for s in dealers:
+        s.close(0)  # close is lifecycle, not a wire op

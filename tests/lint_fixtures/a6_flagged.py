@@ -1,0 +1,14 @@
+"""A6 flagged: per-env socket ops inside loops over env indices (3 findings)."""
+
+import numpy as np
+
+
+def serve_per_env(n_envs, push, dealers, stacks, dumps, loads):
+    actions = np.zeros(n_envs, np.int32)
+    for i in range(n_envs):
+        push.send(dumps(stacks[i]))  # one message per env per step
+    for i in range(n_envs):
+        actions[i] = loads(dealers[i].recv())  # one drain per env per step
+    for sock in dealers:
+        sock.send(b"ack")  # iterating the per-env socket list is the same wire
+    return actions

@@ -19,7 +19,7 @@ from tools.ba3clint.engine import suppressions
 FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-RULE_IDS = ["J1", "J2", "J3", "J4", "J5", "A1", "A2", "A3", "A4", "A5"]
+RULE_IDS = ["J1", "J2", "J3", "J4", "J5", "A1", "A2", "A3", "A4", "A5", "A6"]
 
 
 def _fixture(name):
@@ -66,6 +66,7 @@ def test_expected_flag_counts():
     assert len(_findings("a3_flagged.py", "A3")) == 3
     assert len(_findings("j3_flagged.py", "J3")) == 3
     assert len(_findings("a2_flagged.py", "A2")) == 2
+    assert len(_findings("a6_flagged.py", "A6")) == 3
 
 
 def test_suppressions_silence_real_violations():

@@ -64,5 +64,39 @@ def test_ksweep_stdout_is_one_json_line_with_windows(monkeypatch, capsys):
     assert payload["per_chip_by_K"] == {"1": 101.0, "4": 104.0}
     assert payload["windows_by_K"]["1"] == [90.0, 101.0, 95.0]
     assert payload["windows_by_K"]["4"] == [90.0, 104.0, 95.0]
+    # the shape-keyed rows are always present alongside the legacy keys
+    assert payload["rows"]["128x20"]["per_chip_by_K"] == {"1": 101.0, "4": 104.0}
     # per-K progress goes to stderr, never stdout
     assert "env-steps/s/chip" in captured.err
+
+
+def test_ksweep_shard_shape_rows(monkeypatch, capsys):
+    # --n_envs 8,16: the shard-shape capture (VERDICT r5 Next #1) emits one
+    # row per shape; the legacy single-shape keys are NOT emitted (no one
+    # shape is "the" sweep)
+    mod = _load_ksweep_module()
+
+    def fake_bench_fused(n_envs, rollout_len, iters, steps_per_dispatch):
+        return {
+            "value": 1000.0 * n_envs + steps_per_dispatch,
+            "window_rates": [1000.0 * n_envs + steps_per_dispatch],
+        }
+
+    monkeypatch.setattr(bench, "bench_fused", fake_bench_fused)
+    monkeypatch.setattr(mod, "guard_tpu", lambda *a, **kw: None)
+    monkeypatch.setattr(
+        sys, "argv",
+        ["ksweep_bench.py", "--n_envs", "8,16", "--ks", "1,4", "--total", "8",
+         "--tpu_lock", "off"],
+    )
+    mod.main()
+
+    captured = capsys.readouterr()
+    lines = [ln for ln in captured.out.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    payload = json.loads(lines[0])
+    assert payload["shape"] == "8x20,16x20"
+    assert payload["rows"]["8x20"]["per_chip_by_K"] == {"1": 8001.0, "4": 8004.0}
+    assert payload["rows"]["16x20"]["per_chip_by_K"] == {"1": 16001.0, "4": 16004.0}
+    assert "per_chip_by_K" not in payload  # legacy keys absent on multi-shape
+    assert "windows_by_K" not in payload

@@ -1,0 +1,730 @@
+"""Block wire protocol: parity with the per-env wire, zero-copy codecs,
+shm ring semantics, block prune/heartbeat, FastQueue, predictor blocks.
+
+The parity tests drive BOTH masters OFFLINE with identical deterministic
+trajectories (same FakeEnv seeds, same deterministic policy) and assert the
+emitted experience streams are identical as multisets — the block wire is
+a transport optimization and must be invisible to the learner.
+"""
+
+from __future__ import annotations
+
+import queue
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_ba3c_tpu.actors.master import BA3CSimulatorMaster
+from distributed_ba3c_tpu.actors.simulator import (
+    BlockClientState,
+    BlockStatesView,
+)
+from distributed_ba3c_tpu.actors.vtrace_master import VTraceSimulatorMaster
+from distributed_ba3c_tpu.envs.fake import build_fake_player
+from distributed_ba3c_tpu.utils.concurrency import FastQueue
+from distributed_ba3c_tpu.utils.serialize import pack_block, unpack_block
+
+N_ACTIONS = 4
+
+
+def _policy(state: np.ndarray):
+    """Deterministic (action, value, logp) from pixels — both wire drivers
+    compute the same actions, so trajectories match exactly."""
+    h = int(np.asarray(state, np.uint64).sum())
+    return h % N_ACTIONS, (h % 8) / 8.0, -1.25
+
+
+class _DetPredictor:
+    """Synchronous deterministic predictor stub speaking BOTH task APIs."""
+
+    def put_task(self, state, cb):
+        a, v, lp = _policy(state)
+        cb(a, v, lp)
+
+    def put_block_task(self, states, cb):
+        outs = [_policy(states[j]) for j in range(states.shape[0])]
+        cb(
+            np.asarray([o[0] for o in outs], np.int32),
+            np.asarray([o[1] for o in outs], np.float32),
+            np.asarray([o[2] for o in outs], np.float32),
+        )
+
+
+def _players(n, seed_base=0):
+    return [
+        build_fake_player(
+            seed_base + i, image_size=(16, 16), frame_history=2,
+            num_actions=N_ACTIONS,
+        )
+        for i in range(n)
+    ]
+
+
+def _drive_per_env(master, players, n_steps):
+    b = len(players)
+    idents = [f"sim-{i}".encode() for i in range(b)]
+    states = [p.current_state() for p in players]
+    rewards, overs = [0.0] * b, [False] * b
+    for _ in range(n_steps):
+        for j in range(b):
+            master._on_message(idents[j], states[j], rewards[j], overs[j])
+            a, _, _ = _policy(states[j])
+            rewards[j], overs[j] = players[j].action(a)
+            states[j] = players[j].current_state()
+
+
+def _drive_block(master, players, n_steps):
+    b = len(players)
+    ident = b"blk-0*block"
+    master.clients[ident] = BlockClientState(ident, b)
+    rewards = np.zeros(b, np.float32)
+    overs = np.zeros(b, bool)
+    for _ in range(n_steps):
+        states = np.stack([p.current_state() for p in players])
+        master._on_block_message(ident, states, rewards.copy(), overs.copy())
+        for j in range(b):
+            a, _, _ = _policy(states[j])
+            r, o = players[j].action(a)
+            rewards[j], overs[j] = r, o
+
+
+def _drain(q):
+    out = []
+    while True:
+        try:
+            out.append(q.get_nowait())
+        except queue.Empty:
+            return out
+
+
+def _dp_key(dp):
+    state, action, ret = dp
+    return (np.asarray(state).tobytes(), int(action), float(ret))
+
+
+def test_ba3c_wire_parity(tmp_path):
+    """Block and per-env wires emit IDENTICAL n-step experience streams
+    (as multisets — inter-env interleaving is unspecified on both wires)."""
+    kw = dict(gamma=0.5, local_time_max=3)
+    m1 = BA3CSimulatorMaster(
+        f"ipc://{tmp_path}/a1", f"ipc://{tmp_path}/b1", _DetPredictor(),
+        score_queue=queue.Queue(), **kw,
+    )
+    m2 = BA3CSimulatorMaster(
+        f"ipc://{tmp_path}/a2", f"ipc://{tmp_path}/b2", _DetPredictor(),
+        score_queue=queue.Queue(), **kw,
+    )
+    try:
+        _drive_per_env(m1, _players(4), 50)
+        _drive_block(m2, _players(4), 50)
+        dp1 = sorted(_dp_key(d) for d in _drain(m1.queue))
+        dp2 = sorted(_dp_key(d) for d in _drain(m2.queue))
+        assert len(dp1) > 40  # episodes ended AND windows truncated
+        assert dp1 == dp2
+        s1 = sorted(_drain(m1.score_queue))
+        s2 = sorted(_drain(m2.score_queue))
+        assert s1 == s2 and len(s1) > 0
+    finally:
+        m1.close()
+        m2.close()
+
+
+def test_ba3c_wire_parity_with_reward_clip(tmp_path):
+    """The vectorized clip matches the scalar clip through the block path."""
+    kw = dict(gamma=0.5, local_time_max=2, reward_clip=1.0)
+    m1 = BA3CSimulatorMaster(
+        f"ipc://{tmp_path}/a1", f"ipc://{tmp_path}/b1", _DetPredictor(),
+        score_queue=queue.Queue(), **kw,
+    )
+    m2 = BA3CSimulatorMaster(
+        f"ipc://{tmp_path}/a2", f"ipc://{tmp_path}/b2", _DetPredictor(),
+        score_queue=queue.Queue(), **kw,
+    )
+    try:
+        _drive_per_env(m1, _players(2, seed_base=7), 30)
+        _drive_block(m2, _players(2, seed_base=7), 30)
+        assert sorted(_dp_key(d) for d in _drain(m1.queue)) == sorted(
+            _dp_key(d) for d in _drain(m2.queue)
+        )
+    finally:
+        m1.close()
+        m2.close()
+
+
+def _seg_key(seg):
+    return tuple(
+        np.asarray(seg[k]).tobytes()
+        for k in (
+            "state", "action", "reward", "done", "behavior_log_probs",
+            "bootstrap_state",
+        )
+    )
+
+
+def test_vtrace_wire_parity(tmp_path):
+    """V-trace unroll segments are identical across wires (same seeds)."""
+    m1 = VTraceSimulatorMaster(
+        f"ipc://{tmp_path}/a1", f"ipc://{tmp_path}/b1", _DetPredictor(),
+        unroll_len=3, score_queue=queue.Queue(),
+    )
+    m2 = VTraceSimulatorMaster(
+        f"ipc://{tmp_path}/a2", f"ipc://{tmp_path}/b2", _DetPredictor(),
+        unroll_len=3, score_queue=queue.Queue(),
+    )
+    try:
+        _drive_per_env(m1, _players(3), 40)
+        _drive_block(m2, _players(3), 40)
+        seg1 = sorted(_seg_key(s) for s in _drain(m1.queue))
+        seg2 = sorted(_seg_key(s) for s in _drain(m2.queue))
+        assert len(seg1) >= 3 * (40 // 4)  # unrolls tile time with no gaps
+        assert seg1 == seg2
+    finally:
+        m1.close()
+        m2.close()
+
+
+# -- zero-copy multipart codec ---------------------------------------------
+
+
+def test_pack_block_roundtrip_zero_copy():
+    meta = [b"ident*block", 17, 8]
+    obs = np.arange(4 * 8 * 6 * 5, dtype=np.uint8).reshape(4, 8, 6, 5)
+    rew = np.linspace(-2, 2, 8).astype(np.float32)
+    done = np.zeros(8, np.uint8)
+    frames = pack_block(meta, [obs, rew, done])
+    # simulate the wire: frames arrive as bytes
+    wire = [bytes(f) for f in frames]
+    meta2, (o2, r2, d2) = unpack_block(wire)
+    assert list(meta2) == meta
+    np.testing.assert_array_equal(o2, obs)
+    np.testing.assert_array_equal(r2, rew)
+    np.testing.assert_array_equal(d2, done)
+    # unpack is ZERO-COPY: arrays are views over the received frames
+    for arr in (o2, r2, d2):
+        assert arr.base is not None
+
+
+def test_pack_block_noncontiguous_and_strided():
+    """Strided/transposed inputs round-trip (pack pays the one copy)."""
+    base = np.arange(240, dtype=np.float32).reshape(10, 24)
+    strided = base[::2, ::3]              # non-contiguous view
+    transposed = base.T                   # reversed strides
+    frames = pack_block(None, [strided, transposed])
+    _, (s2, t2) = unpack_block([bytes(f) for f in frames])
+    np.testing.assert_array_equal(s2, strided)
+    np.testing.assert_array_equal(t2, transposed)
+
+
+def test_pack_block_send_side_is_zero_copy_for_contiguous():
+    arr = np.zeros((64, 64), np.uint8)
+    frames = pack_block(None, [arr])
+    # the payload frame IS the array's buffer, not a tobytes() copy
+    assert np.shares_memory(np.frombuffer(frames[1], np.uint8), arr)
+
+
+def test_unpack_block_frame_count_mismatch():
+    frames = pack_block(None, [np.zeros(3, np.uint8)])
+    with pytest.raises(ValueError):
+        unpack_block([bytes(frames[0])])  # header says 1 array, 0 frames
+
+
+# -- BlockStatesView (block-shm states) ------------------------------------
+
+
+def test_block_states_view_mature_rows_are_views():
+    win = np.random.default_rng(0).integers(0, 255, (4, 3, 8, 8)).astype(np.uint8)
+    v = BlockStatesView(win, np.array([5, 5, 5]))
+    assert v.shape == (3, 8, 8, 4) and len(v) == 3
+    row = v[1]
+    assert row.shape == (8, 8, 4)
+    assert np.shares_memory(row, win)  # zero-copy
+    np.testing.assert_array_equal(row, win[:, 1].transpose(1, 2, 0))
+
+
+def test_block_states_view_young_rows_zero_history():
+    win = np.full((4, 2, 4, 4), 9, np.uint8)
+    v = BlockStatesView(win, np.array([0, 2]))
+    r0 = v[0]  # age 0: only the newest plane is real history
+    assert (r0[..., :3] == 0).all() and (r0[..., 3] == 9).all()
+    r1 = v[1]  # age 2: one missing plane
+    assert (r1[..., :1] == 0).all() and (r1[..., 1:] == 9).all()
+    # materialization applies the same zeroing row-wise
+    full = np.asarray(v)
+    np.testing.assert_array_equal(full[0], r0)
+    np.testing.assert_array_equal(full[1], r1)
+
+
+# -- FastQueue --------------------------------------------------------------
+
+
+def test_fast_queue_fifo_and_nowait():
+    q = FastQueue(maxsize=3)
+    for i in range(3):
+        q.put(i)
+    assert q.full() and q.qsize() == 3
+    with pytest.raises(queue.Full):
+        q.put_nowait(99)
+    assert [q.get_nowait() for _ in range(3)] == [0, 1, 2]
+    assert q.empty()
+    with pytest.raises(queue.Empty):
+        q.get_nowait()
+
+
+def test_fast_queue_timeouts():
+    q = FastQueue(maxsize=1)
+    t0 = time.monotonic()
+    with pytest.raises(queue.Empty):
+        q.get(timeout=0.05)
+    assert time.monotonic() - t0 >= 0.04
+    q.put(1)
+    with pytest.raises(queue.Full):
+        q.put(2, timeout=0.05)
+
+
+def test_fast_queue_cross_thread():
+    q = FastQueue(maxsize=128)
+    got = []
+
+    def consumer():
+        for _ in range(1000):
+            got.append(q.get(timeout=5))
+
+    t = threading.Thread(target=consumer, daemon=True)
+    t.start()
+    for i in range(1000):
+        q.put(i, timeout=5)
+    t.join(timeout=10)
+    assert got == list(range(1000))
+
+
+# -- shm ring safety contract ----------------------------------------------
+
+
+def test_shm_ring_capacity_check_refuses_unbounded_queue(tmp_path):
+    m = BA3CSimulatorMaster(
+        f"ipc://{tmp_path}/a", f"ipc://{tmp_path}/b", _DetPredictor(),
+        train_queue=queue.Queue(),  # UNBOUNDED: no backpressure
+    )
+    try:
+        blk = BlockClientState(b"x*block", 4)
+        m.clients[b"x*block"] = blk
+        meta = [b"x*block", 0, 4, "ba3c-ring-test-none", 64, 8, 8, 4]
+        with pytest.raises(ValueError, match="BOUNDED"):
+            m._shm_states(blk, meta, 0, np.zeros(4, bool))
+    finally:
+        m.close()
+
+
+def test_shm_ring_capacity_check_refuses_small_ring(tmp_path):
+    m = BA3CSimulatorMaster(
+        f"ipc://{tmp_path}/a", f"ipc://{tmp_path}/b", _DetPredictor(),
+        train_queue=queue.Queue(maxsize=4096),
+    )
+    try:
+        blk = BlockClientState(b"x*block", 4)
+        m.clients[b"x*block"] = blk
+        # cap 64 << 4096/4: a backed-up queue could outlive the ring
+        meta = [b"x*block", 0, 4, "ba3c-ring-test-none", 64, 8, 8, 4]
+        with pytest.raises(ValueError, match="too small"):
+            m._shm_states(blk, meta, 0, np.zeros(4, bool))
+    finally:
+        m.close()
+
+
+def test_shm_ring_capacity_counts_vtrace_segment_span(tmp_path):
+    # each queued V-trace segment pins a bootstrap_state ring view a whole
+    # unroll behind its head: the check must count T steps per queued item.
+    # This config passed the pre-fix check (64/4 + 20 + 8 = 44 < 64).
+    from distributed_ba3c_tpu.actors.vtrace_master import VTraceSimulatorMaster
+
+    m = VTraceSimulatorMaster(
+        f"ipc://{tmp_path}/a", f"ipc://{tmp_path}/b", _DetPredictor(),
+        unroll_len=20, train_queue=queue.Queue(maxsize=64),
+    )
+    try:
+        blk = BlockClientState(b"x*block", 4)
+        m.clients[b"x*block"] = blk
+        meta = [b"x*block", 0, 4, "ba3c-ring-test-none", 64, 8, 8, 4]
+        with pytest.raises(ValueError, match="too small"):
+            m._shm_states(blk, meta, 0, np.zeros(4, bool))
+    finally:
+        m.close()
+
+
+def test_shm_ring_capacity_counts_feed_holder(tmp_path):
+    # items the feed's collate holder pulled OUT of the queue still pin
+    # ring views; feed_batch declares that capacity to the check. Queue
+    # alone is fine here (32/4 + 5 + 4 + 8 = 25 < 64), holder is not.
+    m = BA3CSimulatorMaster(
+        f"ipc://{tmp_path}/a", f"ipc://{tmp_path}/b", _DetPredictor(),
+        train_queue=queue.Queue(maxsize=32),
+    )
+    m.feed_batch = 2560
+    try:
+        blk = BlockClientState(b"x*block", 4)
+        m.clients[b"x*block"] = blk
+        meta = [b"x*block", 0, 4, "ba3c-ring-test-none", 64, 8, 8, 4]
+        with pytest.raises(ValueError, match="too small"):
+            m._shm_states(blk, meta, 0, np.zeros(4, bool))
+    finally:
+        m.close()
+
+
+def test_shm_ring_create_attach_roundtrip():
+    from distributed_ba3c_tpu.utils import shm
+
+    if not shm.available():
+        pytest.skip("/dev/shm not available")
+    name = f"ba3c-ring-test-{time.monotonic_ns()}"
+    ring = shm.ShmRing.create(name, 4, 2, 8, 8)
+    try:
+        ring.arr[1] = 7
+        peer = shm.ShmRing.attach(name, 4, 2, 8, 8)
+        assert (peer.arr[1] == 7).all() and (peer.arr[0] == 0).all()
+        with pytest.raises(ValueError):
+            shm.ShmRing.attach(name, 8, 2, 8, 8)  # wrong shape
+        peer.close()
+    finally:
+        ring.close(unlink=True)
+    with pytest.raises(OSError):
+        shm.ShmRing.attach(name, 4, 2, 8, 8)  # unlinked
+
+
+class _WireFrame:
+    """Stand-in for zmq.Frame: just the .buffer the master reads."""
+
+    def __init__(self, buf):
+        self.buffer = bytes(buf)
+
+
+def _wire_frames(meta, arrays):
+    return [_WireFrame(f) for f in pack_block(meta, arrays)]
+
+
+def test_block_restart_resets_client_state(tmp_path):
+    # a crashed server restarted under the SAME ident starts over at step 0;
+    # the master must reset the incarnation (pending steps, scores, ages)
+    # instead of attaching post-restart rewards to pre-crash states
+    m = BA3CSimulatorMaster(
+        f"ipc://{tmp_path}/a", f"ipc://{tmp_path}/b", _DetPredictor(),
+        train_queue=queue.Queue(maxsize=64),
+    )
+    try:
+        ident = b"x*block"
+        b, h, w, hist = 2, 8, 8, 2
+        obs = np.zeros((hist, b, h, w), np.uint8)
+        rew, dn = np.zeros(b, np.float32), np.zeros(b, np.uint8)
+        for step in (0, 1, 2):
+            m._on_block_frames(_wire_frames([ident, step, b], [obs, rew, dn]))
+        blk = m.clients[ident]
+        assert blk.last_step == 2 and len(blk.steps) == 3
+        blk.scores[:] = 7.0
+        m._on_block_frames(_wire_frames([ident, 0, b], [obs, rew, dn]))
+        blk2 = m.clients[ident]
+        assert blk2 is not blk, "restart must create a fresh incarnation"
+        assert blk2.last_step == 0 and len(blk2.steps) == 1
+        assert (blk2.scores == 0).all()
+    finally:
+        m.close()
+
+
+def test_block_shm_misconfig_drops_client_not_master(tmp_path):
+    # a ring the safety check refuses must drop THAT client, not kill the
+    # receive loop for every other client (the remote-fleet path cannot be
+    # sized by cli.py, so the refusal is an expected operational error)
+    m = BA3CSimulatorMaster(
+        f"ipc://{tmp_path}/a", f"ipc://{tmp_path}/b", _DetPredictor(),
+        train_queue=queue.Queue(),  # UNBOUNDED: the check refuses
+    )
+    try:
+        ident = b"x*block"
+        meta = [ident, 0, 4, "ba3c-ring-test-none", 64, 8, 8, 4]
+        frames = _wire_frames(
+            meta, [np.zeros(4, np.float32), np.zeros(4, np.uint8)]
+        )
+        m._on_block_frames(frames)  # must swallow the ValueError
+        assert ident not in m.clients
+    finally:
+        m.close()
+
+
+def test_malformed_block_message_skipped_not_fatal(tmp_path):
+    # wire input is untrusted (a version-mismatched remote fleet, or any
+    # stray sender on the bound port): an undecodable message must be
+    # SKIPPED — not raise out of the receive loop, not create a client
+    m = BA3CSimulatorMaster(
+        f"ipc://{tmp_path}/a", f"ipc://{tmp_path}/b", _DetPredictor(),
+        train_queue=queue.Queue(maxsize=64),
+    )
+    try:
+        b, h, w, hist = 2, 8, 8, 2
+        obs = np.zeros((hist, b, h, w), np.uint8)
+        rew, dn = np.zeros(b, np.float32), np.zeros(b, np.uint8)
+        good = _wire_frames([b"x*block", 0, b], [obs, rew, dn])
+        # header is not valid msgpack at all
+        m._on_block_frames([_WireFrame(b"\xc1garbage"), _WireFrame(b"")])
+        # header declares more arrays than the message carries
+        m._on_block_frames(_wire_frames([b"y*block", 0, b], [obs, rew, dn])[:-1])
+        # header meta is not (ident, step, n_envs)-shaped
+        m._on_block_frames(_wire_frames([42], [rew, dn]))
+        # payload shapes contradict the declared n_envs
+        m._on_block_frames(
+            _wire_frames([b"z*block", 0, b + 1], [obs, rew, dn])
+        )
+        assert not m.clients, "malformed messages must not create clients"
+        m._on_block_frames(good)  # the loop is still alive and serving
+        assert b"x*block" in m.clients
+    finally:
+        m.close()
+
+
+def test_shm_ring_recreate_keeps_old_mapping_valid():
+    # restart-over-stale-ring: create() renames a fresh inode over the path,
+    # so a master still mapping the OLD inode reads stale-but-valid data
+    # (no SIGBUS from an in-place truncate) until it re-attaches
+    from distributed_ba3c_tpu.utils import shm
+
+    if not shm.available():
+        pytest.skip("/dev/shm not available")
+    name = f"ba3c-ring-test-{time.monotonic_ns()}"
+    ring1 = shm.ShmRing.create(name, 4, 2, 8, 8)
+    ring2 = None
+    peer = peer2 = None
+    try:
+        ring1.arr[0] = 3
+        peer = shm.ShmRing.attach(name, 4, 2, 8, 8)
+        ring2 = shm.ShmRing.create(name, 4, 2, 8, 8)  # the restart
+        assert (peer.arr[0] == 3).all()  # old mapping intact
+        ring2.arr[0] = 9
+        peer2 = shm.ShmRing.attach(name, 4, 2, 8, 8)
+        assert (peer2.arr[0] == 9).all() and (peer.arr[0] == 3).all()
+    finally:
+        for r in (peer, peer2, ring1):
+            if r is not None:
+                r.close()
+        if ring2 is not None:
+            ring2.close(unlink=True)
+
+
+# -- block client prune / heartbeat under a killed server ------------------
+
+
+def _block_sender_thread(c2s, s2c, ident, n_steps, stop_evt):
+    """A minimal block-wire speaker: send, await actions, repeat — then go
+    SILENT (the killed-server scenario; no goodbye on the wire)."""
+    import zmq
+
+    ctx = zmq.Context()
+    push = ctx.socket(zmq.PUSH)
+    push.connect(c2s)
+    dealer = ctx.socket(zmq.DEALER)
+    dealer.setsockopt(zmq.IDENTITY, ident)
+    dealer.setsockopt(zmq.RCVTIMEO, 10_000)
+    dealer.connect(s2c)
+    b, h, w, hist = 2, 8, 8, 2
+    obs = np.zeros((hist, b, h, w), np.uint8)
+    rewards = np.zeros(b, np.float32)
+    dones = np.zeros(b, np.uint8)
+    try:
+        for step in range(n_steps):
+            push.send_multipart(
+                pack_block([ident, step, b], [obs, rewards, dones])
+            )
+            acts = np.frombuffer(dealer.recv(), np.int32)
+            assert acts.shape == (b,)
+    finally:
+        stop_evt.set()
+        dealer.close(0)
+        push.close(0)
+        ctx.term()
+
+
+def test_block_client_pruned_after_server_death(tmp_path):
+    c2s, s2c = f"ipc://{tmp_path}/c2s", f"ipc://{tmp_path}/s2c"
+    m = BA3CSimulatorMaster(
+        c2s, s2c, _DetPredictor(), gamma=0.5, local_time_max=3,
+        actor_timeout=2.0, score_queue=queue.Queue(),
+    )
+    ident = b"mortal-0*block"
+    done_evt = threading.Event()
+    t = threading.Thread(
+        target=_block_sender_thread, args=(c2s, s2c, ident, 5, done_evt),
+        daemon=True,
+    )
+    m.start()
+    t.start()
+    try:
+        # the block registers and heartbeats while alive
+        deadline = time.monotonic() + 30
+        while ident not in m.clients and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert ident in m.clients, "block client never registered"
+        assert done_evt.wait(timeout=30), "sender never finished its steps"
+        # ...and is pruned once silent for > actor_timeout
+        deadline = time.monotonic() + 30
+        while ident in m.clients and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert ident not in m.clients, "dead block client never pruned"
+    finally:
+        m.close()
+        t.join(timeout=5)
+
+
+# -- predictor block serving -----------------------------------------------
+
+
+def _tiny_predictor(batch_size=8, **kw):
+    import jax
+
+    from distributed_ba3c_tpu.config import BA3CConfig
+    from distributed_ba3c_tpu.models.a3c import BA3CNet
+    from distributed_ba3c_tpu.predict.server import BatchedPredictor
+
+    cfg = BA3CConfig(image_size=(16, 16), fc_units=16, num_actions=N_ACTIONS)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, *cfg.state_shape), np.uint8)
+    )["params"]
+    return BatchedPredictor(model, params, batch_size=batch_size, **kw), cfg
+
+
+def test_put_block_task_serves_whole_block():
+    pred, cfg = _tiny_predictor(batch_size=8, num_threads=1, coalesce_ms=0.0)
+    pred.start()
+    try:
+        got = []
+        evt = threading.Event()
+
+        def cb(actions, values, logps):
+            got.append((actions, values, logps))
+            evt.set()
+
+        states = np.random.default_rng(0).integers(
+            0, 255, (5, *cfg.state_shape)
+        ).astype(np.uint8)
+        pred.put_block_task(states, cb)
+        assert evt.wait(timeout=60)
+        actions, values, logps = got[0]
+        assert actions.shape == values.shape == logps.shape == (5,)
+        assert actions.dtype == np.int32
+        assert ((actions >= 0) & (actions < N_ACTIONS)).all()
+        assert np.isfinite(values).all() and (logps <= 0).all()
+    finally:
+        pred.stop()
+        pred.join(timeout=5)
+
+
+def test_put_block_task_rejects_oversized_block():
+    pred, cfg = _tiny_predictor(batch_size=8)
+    with pytest.raises(ValueError, match="exceeds the serving bucket"):
+        pred.put_block_task(
+            np.zeros((9, *cfg.state_shape), np.uint8), lambda *a: None
+        )
+    pred.stop()
+
+
+@pytest.mark.timeout(600)
+@pytest.mark.parametrize("wire", ["block", "block-shm"])
+def test_live_block_plane_end_to_end(tmp_path, wire):
+    """Real CppEnvServerProcess fleets on both block wires stream through a
+    real predictor into well-formed n-step datapoints + episode scores."""
+    from distributed_ba3c_tpu.envs import native
+
+    if not native.available():
+        pytest.skip("cpp/libba3c_env.so not built (make -C cpp)")
+    if wire == "block-shm":
+        from distributed_ba3c_tpu.utils import shm
+
+        if not shm.available():
+            pytest.skip("/dev/shm not available")
+    import jax
+
+    from distributed_ba3c_tpu.config import BA3CConfig
+    from distributed_ba3c_tpu.models.a3c import BA3CNet
+    from distributed_ba3c_tpu.predict.server import BatchedPredictor
+    from distributed_ba3c_tpu.utils.concurrency import ensure_proc_terminate
+
+    cfg = BA3CConfig(num_actions=6, fc_units=16)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, *cfg.state_shape), np.uint8)
+    )["params"]
+    predictor = BatchedPredictor(model, params, batch_size=8, num_threads=1)
+    c2s, s2c = f"ipc://{tmp_path}/c2s", f"ipc://{tmp_path}/s2c"
+    master = BA3CSimulatorMaster(
+        c2s, s2c, predictor, gamma=cfg.gamma,
+        local_time_max=cfg.local_time_max,
+        score_queue=queue.Queue(maxsize=1000), actor_timeout=300.0,
+    )
+    procs = [
+        native.CppEnvServerProcess(
+            i, c2s, s2c, game="pong", n_envs=4, wire=wire
+        )
+        for i in range(2)
+    ]
+    ensure_proc_terminate(procs)
+    predictor.start()
+    master.start()
+    for p in procs:
+        p.start()
+    try:
+        datapoints = []
+        deadline = time.time() + 550
+        while len(datapoints) < 64 and time.time() < deadline:
+            try:
+                datapoints.append(master.queue.get(timeout=5))
+            except queue.Empty:
+                for p in procs:
+                    assert p.is_alive(), f"server died, exitcode={p.exitcode}"
+        assert len(datapoints) >= 64, "block plane produced too few datapoints"
+        for state, action, ret in datapoints:
+            s = np.asarray(state)
+            assert s.shape == cfg.state_shape and s.dtype == np.uint8
+            assert 0 <= action < cfg.num_actions
+            assert np.isfinite(ret)
+        # both servers registered as BLOCK clients
+        assert sum(
+            isinstance(c, BlockClientState) for c in master.clients.values()
+        ) == 2
+    finally:
+        for p in procs:
+            p.terminate()
+        master.close()
+        predictor.stop()
+        predictor.join(timeout=5)
+        for p in procs:
+            p.join(timeout=5)
+
+
+def test_mixed_singles_and_blocks_coalesce():
+    pred, cfg = _tiny_predictor(batch_size=8, num_threads=1, coalesce_ms=20.0)
+    try:
+        single_got, block_got = [], []
+        n_singles, block_b = 3, 4
+        all_done = threading.Barrier(2, timeout=120)
+
+        def maybe_done():
+            if len(single_got) == n_singles and len(block_got) == 1:
+                all_done.wait()
+
+        for i in range(n_singles):
+            pred.put_task(
+                np.full(cfg.state_shape, i, np.uint8),
+                lambda a, v, lp: (single_got.append((a, v, lp)), maybe_done()),
+            )
+        pred.put_block_task(
+            np.zeros((block_b, *cfg.state_shape), np.uint8),
+            lambda a, v, lp: (block_got.append((a, v, lp)), maybe_done()),
+        )
+        pred.start()
+        all_done.wait()
+        assert len(single_got) == n_singles
+        assert block_got[0][0].shape == (block_b,)
+        for a, v, lp in single_got:
+            assert isinstance(a, int) and isinstance(v, float)
+    finally:
+        pred.stop()
+        pred.join(timeout=5)

@@ -235,7 +235,12 @@ def test_cpp_env_server_speaks_wire_protocol(tmp_path):
     router = ctx.socket(zmq.ROUTER)
     router.bind(s2c)
 
-    proc = native.CppEnvServerProcess(0, c2s, s2c, game="pong", n_envs=3)
+    # this test pins the PER-ENV reference protocol (SimulatorProcess
+    # compatibility); the block wires have their own live e2e coverage in
+    # test_block_wire.py
+    proc = native.CppEnvServerProcess(
+        0, c2s, s2c, game="pong", n_envs=3, wire="per-env"
+    )
     proc.start()
 
     def recv_with_liveness(deadline):

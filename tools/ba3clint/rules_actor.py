@@ -324,10 +324,111 @@ class PrivateImportRule(Rule):
                     )
 
 
+_WIRE_OPS = {
+    "send", "recv", "send_multipart", "recv_multipart", "send_pyobj",
+    "recv_pyobj", "send_string", "recv_string", "send_json", "recv_json",
+    "send_serialized", "recv_serialized",
+}
+_SOCKISH_FRAGMENTS = ("sock", "dealer", "router", "push", "pull", "zmq")
+
+
+def _socket_ish(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name and any(f in name.lower() for f in _SOCKISH_FRAGMENTS):
+            return True
+    return False
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(target) if isinstance(n, ast.Name)}
+
+
+def _env_indexed_iter(it: ast.AST) -> bool:
+    for sub in ast.walk(it):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name and "env" in name.lower():
+            return True
+    return False
+
+
+class PerEnvWireLoopRule(Rule):
+    """A6: per-element socket send/recv inside a loop over env indices.
+
+    The per-env wire — B sends and B drains per step where ``env.step``
+    already produced the whole [B, ...] block — is what pinned the plane at
+    2,128 env-steps/s/host (PERF.md round 4); the block wire replaced it
+    with 2 socket ops per server per step (docs/actor_plane.md). A wire op
+    executed once per env index regresses exactly that, so it must either
+    become one batched multipart op outside the loop or carry a suppression
+    naming why per-element is intended (the `--wire per-env` compat foil in
+    ``envs/native.py`` is the only sanctioned case).
+    """
+
+    id = "A6"
+    name = "per-env-wire-loop"
+    summary = "per-element socket send/recv in a loop over env indices regresses the block wire"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        seen: Set[int] = set()
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, ast.For):
+                continue
+            targets = _target_names(loop.target)
+            env_iter = _env_indexed_iter(loop.iter)
+            for node in ast.walk(loop):
+                if id(node) in seen or not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if not isinstance(fn, ast.Attribute) or fn.attr not in _WIRE_OPS:
+                    continue
+                if not _socket_ish(fn.value):
+                    continue
+                if (
+                    env_iter
+                    or self._loop_var_indexes(node, targets)
+                    or self._receiver_is_loop_var(fn.value, targets)
+                ):
+                    seen.add(id(node))
+                    yield ctx.finding(
+                        self, node,
+                        f"per-element .{fn.attr}() inside a loop over env "
+                        "indices — batch the block into ONE multipart "
+                        "message per step (see docs/actor_plane.md), or "
+                        "suppress with the reason per-element is intended",
+                    )
+
+    @staticmethod
+    def _loop_var_indexes(call: ast.Call, targets: Set[str]) -> bool:
+        # the loop variable used as a subscript INDEX anywhere in the call
+        # (`dealers[i].recv()`, `push.send(stacks[i])`) = a per-env element op
+        for sub in ast.walk(call):
+            if isinstance(sub, ast.Subscript):
+                for n in ast.walk(sub.slice):
+                    if isinstance(n, ast.Name) and n.id in targets:
+                        return True
+        return False
+
+    @staticmethod
+    def _receiver_is_loop_var(recv: ast.AST, targets: Set[str]) -> bool:
+        # iterating the socket collection itself: `for s in dealers: s.send(..)`
+        root = chain_root(recv)
+        return isinstance(root, ast.Name) and root.id in targets
+
+
 ACTOR_RULES = [
     BareThreadRule(),
     BlockingQueueOpRule(),
     CrossThreadClientMutationRule(),
     WallClockArithRule(),
     PrivateImportRule(),
+    PerEnvWireLoopRule(),
 ]
