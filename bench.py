@@ -154,6 +154,17 @@ def bench_fused(
     env_steps = iters * n_envs * n_chips * rollout_len
     host_rate = env_steps / best_dt
     per_chip = host_rate / n_chips
+    # account the measured work in the learner registry so the embedded
+    # telemetry snapshot below reflects this run (docs/observability.md)
+    from distributed_ba3c_tpu import telemetry
+
+    # 1 warmup step + 3 timed windows of `iters` updates
+    telemetry.registry("learner").counter("train_steps_total").inc(
+        3 * iters + 1
+    )
+    telemetry.registry("learner").counter("train_samples_total").inc(
+        (3 * iters + 1) * n_envs * n_chips * rollout_len
+    )
     return {
         "metric": "fused_pong_env_steps_per_sec_per_chip",
         "value": round(per_chip, 1),
@@ -171,6 +182,19 @@ def bench_fused(
         "steps_per_dispatch": K,
         "policy": f"best_of_3_windows, {iters // K} scanned dispatch(es) per window",
         "window_rates": [round(env_steps / dt, 1) for dt in window_dts],
+        "telemetry": _tele_snapshot(),
+    }
+
+
+def _tele_snapshot() -> dict:
+    """Compact final telemetry snapshot embedded in every bench JSON:
+    counters/gauges as scalars per role (histograms as _count/_sum)."""
+    from distributed_ba3c_tpu import telemetry
+
+    return {
+        role: reg.scalars()
+        for role, reg in sorted(telemetry.all_registries().items())
+        if reg.scalars()
     }
 
 
@@ -210,11 +234,51 @@ def make_null_predictor(model, params, n_actions: int, **kw):
     return _NullDevicePredictor(model, params, **kw)
 
 
+def _master_progress() -> tuple:
+    """(wire messages, datapoints) from the master registry — the plane's
+    provable forward motion, read lock-free off the live counters."""
+    from distributed_ba3c_tpu import telemetry
+
+    s = telemetry.registry("master").scalars()
+    msgs = (
+        s.get("per_env_msgs_total", 0)
+        + s.get("block_msgs_total", 0)
+        + s.get("block_shm_msgs_total", 0)
+    )
+    return msgs, s.get("datapoints_total", 0)
+
+
+def _stall_attribution() -> str:
+    """Name the dead stage from the real counters (the bare time threshold
+    used to be the whole diagnosis; now it only opens the case)."""
+    from distributed_ba3c_tpu import telemetry
+
+    m = telemetry.registry("master").scalars()
+    p = telemetry.registry("predictor").scalars()
+    msgs, dps = _master_progress()
+    depth = m.get("train_queue_depth", 0)
+    parts = (
+        f"wire_msgs={msgs:.0f} datapoints={dps:.0f} "
+        f"train_queue_depth={depth:.0f} "
+        f"predictor_batches={p.get('batches_total', 0):.0f} "
+        f"blocked_puts={m.get('queue_blocked_puts_total', 0):.0f}"
+    )
+    if not telemetry.enabled():
+        return f"telemetry disabled, no attribution ({parts})"
+    if msgs == 0:
+        return f"no wire traffic: env servers never connected or died ({parts})"
+    if p.get("batches_total", 0) == 0:
+        return f"wire traffic but predictor never served ({parts})"
+    if dps == 0:
+        return f"predictor serving but no datapoints: flush path stalled ({parts})"
+    return f"plane went quiet after progress ({parts})"
+
+
 def bench_zmq_plane(
     game: str = "pong", n_envs: int = 256, seconds: float = 20.0,
     null_device: bool = False, wire: str = "per-env",
     envs_per_proc: int = 32, warmup_datapoints: int = 512,
-    windows: int = 1,
+    windows: int = 1, telemetry_on: bool = True,
 ) -> dict:
     """Actor-plane throughput (BASELINE configs #1/#2): C++ batched env
     servers -> ZMQ -> master -> batched TPU predictor, counting n-step
@@ -239,11 +303,19 @@ def bench_zmq_plane(
 
     import numpy as np
 
+    from distributed_ba3c_tpu import telemetry
     from distributed_ba3c_tpu.actors.master import BA3CSimulatorMaster
     from distributed_ba3c_tpu.config import BA3CConfig
     from distributed_ba3c_tpu.envs import native
     from distributed_ba3c_tpu.models.a3c import BA3CNet
     from distributed_ba3c_tpu.predict.server import BatchedPredictor
+
+    # per-run telemetry accounting: fresh registries, and the A/B switch
+    # for the overhead gate (scripts/plane_bench.py --telemetry both).
+    # Children inherit the env var through spawn.
+    telemetry.reset_all()
+    telemetry.set_enabled(telemetry_on)
+    os.environ["BA3C_TELEMETRY"] = "1" if telemetry_on else "0"
 
     n_actions = native.CppBatchedEnv(game, 1).num_actions
     cfg = BA3CConfig(num_actions=n_actions, predict_batch_size=256)
@@ -297,9 +369,18 @@ def bench_zmq_plane(
         # timeout is generous: spawning the server fleet re-imports
         # numpy/zmq per process and takes minutes under load
         # (tests/test_native_env.py saw the same)
-        master.queue.get(timeout=300)
-        for _ in range(warmup_datapoints - 1):
-            master.queue.get(timeout=60)
+        try:
+            master.queue.get(timeout=300)
+            for _ in range(warmup_datapoints - 1):
+                master.queue.get(timeout=60)
+        except queue.Empty:
+            # a bare Empty says "timeout"; the counters say WHICH stage
+            # never moved (fleet spawn, predictor serve, flush) — the
+            # difference between a mystery and a diagnosis when a fleet
+            # shape fails to come up (docs/observability.md)
+            raise RuntimeError(
+                f"plane produced no warmup data — {_stall_attribution()}"
+            ) from None
         window_rates = []
         q = master.queue
         for _ in range(max(1, windows)):
@@ -324,15 +405,25 @@ def bench_zmq_plane(
                 except queue.Empty:
                     if empty_since is None:
                         empty_since = now
+                        stall_mark = _master_progress()[1]
                     elif now - empty_since > min(5.0, seconds / 2):
-                        # must be REACHABLE inside one window (< seconds),
-                        # else the deadline expires first and a wedged wire
-                        # silently publishes a near-zero rate instead of
-                        # failing; post-warmup the plane is never quiet for
-                        # a full half-window unless something died
+                        # the quiet threshold only OPENS the investigation
+                        # (it must be reachable inside one window, else the
+                        # deadline expires first and a wedged wire silently
+                        # publishes a near-zero rate); the VERDICT comes
+                        # from the real counters — a master that provably
+                        # emitted DATAPOINTS during the quiet spell is
+                        # draining elsewhere, not stalled. Datapoints ONLY:
+                        # wire messages still ticking while the flush path
+                        # is dead is the "flush path stalled" wedge itself
+                        # and must keep counting toward the raise
+                        if _master_progress()[1] != stall_mark:
+                            empty_since = None
+                            continue
                         raise RuntimeError(
-                            f"plane stalled: {min(5.0, seconds / 2):.1f}s "
-                            "without data post-warmup"
+                            "plane stalled: "
+                            f"{min(5.0, seconds / 2):.1f}s without data "
+                            f"post-warmup — {_stall_attribution()}"
                         )
                     time.sleep(0.002)
             window_rates.append(n / (time.perf_counter() - t0))
@@ -347,6 +438,8 @@ def bench_zmq_plane(
     rate = max(window_rates)
     kind = "nodevice" if null_device else "tpu"
     return {
+        "telemetry_enabled": telemetry_on,
+        "telemetry": _tele_snapshot(),
         # the null-predictor ceiling must be UNMISTAKABLE from a real plane
         # measurement: distinct metric name + an explicit predictor field
         "metric": f"zmq_plane_{kind}_{game}_env_steps_per_sec_per_host",

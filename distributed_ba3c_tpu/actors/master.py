@@ -10,6 +10,7 @@ returns pushed to the training queue.
 from __future__ import annotations
 
 import queue
+import time
 from typing import Optional
 
 import numpy as np
@@ -101,6 +102,7 @@ class BA3CSimulatorMaster(SimulatorMaster):
                 self.queue, [k.state, k.action, np.float32(R)]
             ):
                 return  # master stopped while the learner was backed up
+        self._c_datapoints.inc(len(mem))  # one batched inc per flush
         client.memory = [] if is_over else [last]
 
     # -- block wire (one message per env-server per step) ------------------
@@ -188,5 +190,13 @@ class BA3CSimulatorMaster(SimulatorMaster):
             for i, j in enumerate(js):
                 if not put(q, [states[j], acts[i], R32[i]]):
                     return False
+        # telemetry, batched per cohort (not per datapoint — hot-path
+        # budget): datapoint count plus the e2e env-step -> train-ingest
+        # latency of the cohort's OLDEST step (the worst case). recv_t is
+        # 0.0 when telemetry is disabled — skip the monotonic math so the
+        # off mode runs the true pre-telemetry hot path
+        self._c_datapoints.inc((e - s) * cohort.size)
+        if blk.steps[s].recv_t:
+            self._h_ingest.observe(time.monotonic() - blk.steps[s].recv_t)
         return True
 

@@ -22,12 +22,14 @@ import os
 import queue
 import threading
 import time
+import weakref
 from abc import abstractmethod
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
 import zmq
 
+from distributed_ba3c_tpu import telemetry
 from distributed_ba3c_tpu.envs.base import RLEnvironment
 from distributed_ba3c_tpu.utils import logger, sanitizer
 from distributed_ba3c_tpu.utils.concurrency import (
@@ -71,7 +73,9 @@ class BlockStep:
     the rewards/dones that arrive one step later (block wire analogue of
     :class:`TransitionExperience`, but [B]-vectorized)."""
 
-    __slots__ = ("states", "actions", "values", "logps", "rewards", "dones")
+    __slots__ = (
+        "states", "actions", "values", "logps", "rewards", "dones", "recv_t",
+    )
 
     def __init__(self, states, actions, values, logps):
         self.states = states      # [B, H, W, hist] u8 (view over the frame)
@@ -80,6 +84,11 @@ class BlockStep:
         self.logps = logps        # [B] f32
         self.rewards = None       # [B] f32, attached by the NEXT message
         self.dones = None         # [B] bool, attached by the NEXT message
+        # birth stamp for the e2e env-step -> train-ingest latency series
+        # (one monotonic per BLOCK step, not per env — telemetry budget);
+        # 0.0 when disabled so the overhead gate's off arm runs the true
+        # pre-telemetry hot path (flush sites skip the observe on falsy)
+        self.recv_t = time.monotonic() if telemetry.enabled() else 0.0
 
 
 class BlockStatesView:
@@ -211,14 +220,44 @@ class SimulatorProcess(_spawn_ctx.Process):  # type: ignore[name-defined]
         s2c.setsockopt(zmq.IDENTITY, ident)
         s2c.connect(self.s2c)
 
+        # child-side telemetry: counters + the piggyback tracker (fleet
+        # aggregation, telemetry/wire.py). Disabled (BA3C_TELEMETRY=0) the
+        # wire stays at its old 4-element message format. SAME series as
+        # the C++ env servers' _tele_setup (envs/native.py) — the fleet
+        # aggregation must not depend on which sender type a run uses.
+        tele = telemetry.registry("simulator")
+        c_steps = tele.counter("env_steps_total")
+        c_eps = tele.counter("episodes_total")
+        c_rew_pos = tele.counter("reward_pos_sum")
+        c_rew_neg = tele.counter("reward_neg_sum")
+        tracker = telemetry.DeltaTracker(tele)
+
         state = player.current_state()
         reward, is_over = 0.0, False
+        step = 0
         try:
             while True:
-                c2s.send(dumps([ident, state, reward, is_over]))
+                msg = [ident, state, reward, is_over]
+                if (
+                    telemetry.enabled()
+                    and step and step % telemetry.PIGGYBACK_EVERY == 0
+                ):
+                    d = tracker.deltas()
+                    if d:
+                        msg.append(d)  # length-versioned 5th element
+                c2s.send(dumps(msg))
                 action = loads(s2c.recv())
                 reward, is_over = player.action(action)
+                c_steps.inc()
+                if is_over:
+                    c_eps.inc()
+                # sign-split like native.py: both halves stay monotonic
+                if reward > 0:
+                    c_rew_pos.inc(reward)
+                elif reward < 0:
+                    c_rew_neg.inc(-reward)
                 state = player.current_state()
+                step += 1
         except (KeyboardInterrupt, zmq.ContextTerminated):
             pass
         finally:
@@ -281,6 +320,52 @@ class SimulatorMaster(threading.Thread):
         # still pin ring views until collate's np.stack copies them
         self.feed_batch = 0
 
+        # -- telemetry (docs/observability.md): counters are fetched ONCE
+        # here and kept as attributes so the hot path pays a dict-get per
+        # BATCH, never a registry lookup. Gauges bind weakly — the registry
+        # outlives any one master and must not pin a closed one alive.
+        tele = telemetry.registry("master")
+        self._flight = telemetry.flight_recorder()
+        self._c_per_env_msgs = tele.counter("per_env_msgs_total")
+        self._c_block_msgs = tele.counter("block_msgs_total")
+        self._c_block_shm_msgs = tele.counter("block_shm_msgs_total")
+        self._c_datapoints = tele.counter("datapoints_total")
+        self._c_pruned = tele.counter("clients_pruned_total")
+        self._c_dropped = tele.counter("clients_dropped_total")
+        self._c_rejected = tele.counter("blocks_rejected_total")
+        self._c_incarnation = tele.counter("incarnation_resets_total")
+        self._c_blocked_puts = tele.counter("queue_blocked_puts_total")
+        self._h_put_wait = tele.histogram("queue_put_wait_s", unit=1e-6)
+        self._h_ingest = tele.histogram("e2e_ingest_latency_s", unit=1e-6)
+        ref = weakref.ref(self)
+        tele.gauge(
+            "clients", fn=lambda: len(m.clients) if (m := ref()) else 0
+        )
+        tele.gauge(
+            "send_queue_depth",
+            fn=lambda: m.send_queue.qsize() if (m := ref()) else 0,
+        )
+        # subclasses create self.queue after super().__init__ — read late
+        tele.gauge(
+            "train_queue_depth",
+            fn=lambda: (
+                q.qsize()
+                if (m := ref()) and (q := getattr(m, "queue", None))
+                else 0
+            ),
+        )
+        tele.gauge(
+            "block_backlog_steps",
+            fn=lambda: max(
+                (
+                    len(c.steps)
+                    for c in list(getattr(ref(), "clients", {}).values())
+                    if isinstance(c, BlockClientState)
+                ),
+                default=0,
+            ),
+        )
+
         def send_loop():
             t = threading.current_thread()
             assert isinstance(t, StoppableThread)
@@ -323,7 +408,14 @@ class SimulatorMaster(threading.Thread):
                 # back the numpy views directly (zero-copy ingest).
                 frames = self.c2s_socket.recv_multipart(copy=False)
                 if len(frames) == 1:
-                    ident, state, reward, is_over = loads(frames[0].buffer)
+                    msg = loads(frames[0].buffer)
+                    ident, state, reward, is_over = msg[:4]
+                    if len(msg) > 4:
+                        # length-versioned header: element 5 is the sender's
+                        # piggybacked metric deltas (telemetry/wire.py);
+                        # plain 4-element messages parse as before
+                        telemetry.apply_fleet_deltas(ident, msg[4])
+                    self._c_per_env_msgs.inc()
                     client = self.clients[ident]
                     client.ident = ident
                     client.last_seen = time.monotonic()
@@ -353,16 +445,32 @@ class SimulatorMaster(threading.Thread):
             for ident, c in self.clients.items()
             if now - c.last_seen > self.actor_timeout
         ]
+        # account FIRST, remove LAST: anything polling the client table
+        # (the prune tests, a scrape of the clients gauge) must find the
+        # counter ticked and the postmortem on disk by the time the client
+        # is gone — the reverse order races every observer
         for ident in dead:
             client = self.clients[ident]
-            del self.clients[ident]
-            if isinstance(client, BlockClientState):
-                client.close()  # release the shm ring mapping, if any
+            self._c_pruned.inc()
+            self._flight.record(
+                "prune",
+                ident=repr(ident),
+                silent_s=round(now - client.last_seen, 3),
+                block=isinstance(client, BlockClientState),
+            )
             logger.warn(
                 "actor %s silent for >%.0fs — dropped its client state",
                 ident,
                 self.actor_timeout,
             )
+        if dead:
+            # a prune IS the postmortem moment: the next wedged multi-hour
+            # run must find evidence on disk, not in a truncated log
+            self._flight.dump("actor prune")
+        for ident in dead:
+            client = self.clients.pop(ident)
+            if isinstance(client, BlockClientState):
+                client.close()  # release the shm ring mapping, if any
 
     def _on_message(self, ident: bytes, state, reward: float, is_over: bool) -> None:
         """Handle one simulator message (overridable; runs in master thread).
@@ -415,20 +523,31 @@ class SimulatorMaster(threading.Thread):
         try:
             if len(bufs) == 4:
                 meta, (obs, rewards, dones) = unpack_block(bufs)
+                base_meta_len = 3  # [ident, step, B]
+                self._c_block_msgs.inc()
             else:
                 meta, (rewards, dones) = unpack_block(bufs)
                 obs = None
+                base_meta_len = 8  # [ident, step, B, ring, cap, h, w, hist]
+                self._c_block_shm_msgs.inc()
             ident, step, n_envs = bytes(meta[0]), int(meta[1]), int(meta[2])
             if rewards.shape != (n_envs,) or dones.shape != (n_envs,):
                 raise ValueError(
                     f"block payload shapes {rewards.shape}/{dones.shape} "
                     f"do not match header n_envs={n_envs}"
                 )
+            if len(meta) > base_meta_len:
+                # length-versioned header: the last element is the server's
+                # piggybacked metric deltas (telemetry/wire.py); old
+                # base-length headers parse exactly as before
+                telemetry.apply_fleet_deltas(ident, meta[base_meta_len])
         except (ValueError, TypeError, IndexError) as e:
             # wire input is untrusted: a version-mismatched fleet (or any
             # stray sender on the bound port) must not kill the receive
             # loop for every healthy client — skip the message. The sender,
             # if it is a real env server, parks in recv() and gets pruned.
+            self._c_rejected.inc()
+            self._flight.record("block_reject", error=str(e)[:200])
             logger.error("dropping undecodable block message: %s", e)
             return
         blk = self.clients.get(ident)
@@ -438,6 +557,11 @@ class SimulatorMaster(threading.Thread):
             # steps awaiting rewards, episode ages, the old ring inode)
             # would misalign every datapoint — drop it and start a fresh
             # incarnation, same semantics as a prune + reconnect.
+            self._c_incarnation.inc()
+            self._flight.record(
+                "incarnation_reset",
+                ident=repr(ident), step=step, last_step=blk.last_step,
+            )
             logger.warn(
                 "block client %s restarted (step %d after %d) — resetting "
                 "its state", ident, step, blk.last_step,
@@ -465,16 +589,23 @@ class SimulatorMaster(threading.Thread):
             # buffering, or a block speaker against a per-env-only master)
             # must not kill the receive loop for every other client: drop
             # it — the server stays parked in its recv() — and keep serving
+            self._c_dropped.inc()
+            self._flight.record(
+                "client_drop", ident=repr(ident), error=str(e)[:200]
+            )
             logger.error(
                 "dropping block client %s (it will get no reply and stay "
                 "blocked): %s", ident, e,
             )
             del self.clients[ident]
             blk.close()
+            self._flight.dump("client drop")
 
     def _shm_states(self, blk, meta, step: int, dones: np.ndarray):
         """Build the step's lazy states view from the client's shm ring."""
-        _, _, n_envs, ring_name, cap, h, w, hist = meta
+        # meta[2:8] — not full destructuring: a piggybacked header carries
+        # one extra telemetry element (telemetry/wire.py)
+        n_envs, ring_name, cap, h, w, hist = meta[2:8]
         if blk.ring is None:
             from distributed_ba3c_tpu.utils.shm import ShmRing, min_safe_cap
 
@@ -516,6 +647,10 @@ class SimulatorMaster(threading.Thread):
                     "pass a larger shm_ring_cap to the env server"
                 )
             blk.ring = ShmRing.attach(ring_name, cap, n_envs, h, w)
+            self._flight.record(
+                "ring_attach", ident=repr(blk.ident),
+                ring=str(ring_name), cap=int(cap),
+            )
         ring = blk.ring.arr
         slot = step % cap
         if step >= hist - 1 and slot >= hist - 1:
@@ -600,8 +735,30 @@ class SimulatorMaster(threading.Thread):
     def _put_stoppable(self, q: queue.Queue, item, timeout: float = 0.5) -> bool:
         """Backpressure that stays shutdown-responsive: bounded-timeout puts
         re-checking the stop flag (the plane's only sanctioned blocking put —
-        ba3clint A2). Returns False if the master stopped while waiting."""
-        return queue_put_stoppable(q, item, self._stop_evt, timeout)
+        ba3clint A2). Returns False if the master stopped while waiting.
+
+        Telemetry rides the SLOW path only: the common non-blocked put is
+        one ``put_nowait`` (same cost as before); a put that actually hits
+        backpressure pays two monotonic reads against a wait that is always
+        orders of magnitude longer."""
+        if self._stop_evt.is_set():
+            # the fast path must not outlive stop(): flush loops abort on
+            # the first False, same as queue_put_stoppable's own guard
+            return False
+        try:
+            q.put_nowait(item)
+            return True
+        except queue.Full:
+            pass
+        self._c_blocked_puts.inc()
+        t0 = time.monotonic()
+        ok = queue_put_stoppable(q, item, self._stop_evt, timeout)
+        waited = time.monotonic() - t0
+        self._h_put_wait.observe(waited)
+        if waited >= 0.05:
+            # the flight ring wants stalls, not the steady-state jitter
+            self._flight.record("queue_wait", wait_s=round(waited, 4))
+        return ok
 
     def stop(self) -> None:
         self._stop_evt.set()

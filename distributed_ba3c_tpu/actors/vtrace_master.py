@@ -15,6 +15,7 @@ replacement (BASELINE.json config #4).
 from __future__ import annotations
 
 import queue
+import time
 from typing import Optional
 
 import numpy as np
@@ -142,6 +143,7 @@ class VTraceSimulatorMaster(SimulatorMaster):
         client.memory = rest
         # backpressure pauses actors, but must stay shutdown-responsive
         self._put_stoppable(self.queue, segment)
+        self._c_datapoints.inc(T)
 
     # -- block wire (one message per env-server per step) ------------------
     def _on_block_state(self, states: np.ndarray, ident: bytes) -> None:
@@ -193,4 +195,10 @@ class VTraceSimulatorMaster(SimulatorMaster):
                 }
                 blk.start[j] = s + T
                 self._put_stoppable(self.queue, segment)
+                # batched telemetry per emitted segment (T datapoints, one
+                # inc) + e2e latency of the segment's head step (recv_t is
+                # 0.0 with telemetry disabled — skip the monotonic math)
+                self._c_datapoints.inc(T)
+                if seg[0].recv_t:
+                    self._h_ingest.observe(time.monotonic() - seg[0].recv_t)
         self._drop_flushed_prefix(blk)

@@ -115,6 +115,18 @@ class RetraceTripwire:
         self._tls.traced = False
         out = self._jitted(*args, **kwargs)
         if self.armed and getattr(self._tls, "traced", False):
+            try:
+                # leave postmortem evidence before raising: the retrace is
+                # exactly the mid-run stall class the flight recorder exists
+                # for (telemetry/recorder.py)
+                from distributed_ba3c_tpu import telemetry
+
+                telemetry.record(
+                    "retrace", entry=self.name, trace=self.traces
+                )
+                telemetry.dump("AuditError")
+            except Exception:
+                pass  # telemetry must never mask the audit finding
             raise AuditError(
                 f"[audit] entry point {self.name!r} re-traced after warmup "
                 f"(trace #{self.traces}) — an input changed "

@@ -96,6 +96,7 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--anneal_lr", default=None, choices=["linear", "exp"], help="override --anneal for learning_rate only (β and lr want different shapes: β drops early, lr holds through the mid-game)")
     p.add_argument("--anneal_beta", default=None, choices=["linear", "exp"], help="override --anneal for entropy_beta only")
     p.add_argument("--profiler_port", type=int, default=0, help="start jax.profiler server on this port (0=off)")
+    p.add_argument("--telemetry_port", type=int, default=0, help="serve the telemetry scrape endpoint on this port (0=off): /metrics Prometheus text, /json raw snapshots, /flight the live flight-recorder ring (docs/observability.md)")
     p.add_argument("--pipe_c2s", default=None, help="master experience-plane bind address, e.g. tcp://0.0.0.0:5555 (default: per-pid ipc://)")
     p.add_argument("--pipe_s2c", default=None, help="master action-plane bind address, e.g. tcp://0.0.0.0:5556 (default: per-pid ipc://)")
     p.add_argument("--max_to_keep", type=int, default=3, help="checkpoints retained (besides best); raise to keep every eval-epoch checkpoint for post-hoc crossing verification")
@@ -306,6 +307,19 @@ def main(argv: Optional[list] = None) -> int:
 
         start_server(args.profiler_port)
 
+    # telemetry plane (docs/observability.md): postmortem dumps land in the
+    # logdir, and a launcher's SIGTERM stall-kill leaves the flight ring on
+    # disk instead of a truncated log
+    from distributed_ba3c_tpu import telemetry
+
+    telemetry.configure(args.logdir)
+    if args.logdir:
+        # spawned children (env servers, simulators) read this at import —
+        # without it their postmortem dumps land in /tmp, not the logdir
+        os.environ["BA3C_FLIGHT_DIR"] = args.logdir
+    if args.task == "train":
+        telemetry.install_signal_dump()
+
     if args.task == "eval":
         state = create_train_state(jax.random.PRNGKey(0), model, cfg, optimizer)
         return _run_eval(args, cfg, model, state)
@@ -512,8 +526,15 @@ def main(argv: Optional[list] = None) -> int:
             "MaxSaver keep-best falls back to the sampling-policy mean_score",
             args.nr_eval, args.env,
         )
+    # scrape endpoint: start/stop with the rest of the plane (it satisfies
+    # the StartProcOrThread protocol — start/stop/join/close)
+    tele_servers = (
+        [telemetry.TelemetryServer(args.telemetry_port)]
+        if args.telemetry_port
+        else []
+    )
     callbacks = [
-        StartProcOrThread([predictor, master, feed] + procs),
+        StartProcOrThread([predictor, master, feed] + procs + tele_servers),
         HumanHyperParamSetter("learning_rate", shared_dir=base_logdir),
         HumanHyperParamSetter("entropy_beta", shared_dir=base_logdir),
         StatPrinter(),

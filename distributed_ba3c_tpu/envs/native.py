@@ -247,6 +247,49 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
         else:
             self._run_per_env()
 
+    def _tele_setup(self):
+        """Child-side telemetry: counters + the piggyback delta tracker.
+
+        Returns ``(count_step, piggyback)``: ``count_step(rew, dn)`` is
+        called once per lockstep block step; ``piggyback(step)`` returns
+        the deltas dict to append to the wire header (or None — which
+        keeps the header at its OLD length, so telemetry-disabled fleets
+        exercise the pre-telemetry wire format end-to-end)."""
+        from distributed_ba3c_tpu import telemetry
+
+        tele = telemetry.registry("simulator")
+        c_steps = tele.counter("env_steps_total")
+        c_eps = tele.counter("episodes_total")
+        # reward split by sign: raw Atari rewards go NEGATIVE (Pong -1),
+        # and a decreasing series exported as a Prometheus counter reads
+        # as a counter reset (rate() spikes). Two monotonic halves keep
+        # counter semantics; net reward = pos - neg at query time.
+        c_rew_pos = tele.counter("reward_pos_sum")
+        c_rew_neg = tele.counter("reward_neg_sum")
+        tracker = telemetry.DeltaTracker(tele)
+        B = self.n_envs
+
+        def count_step(rew, dn) -> None:
+            c_steps.inc(B)
+            n_done = int(dn.sum())
+            if n_done:
+                c_eps.inc(n_done)
+            pos = float(rew[rew > 0].sum())
+            neg = -float(rew[rew < 0].sum())
+            if pos:
+                c_rew_pos.inc(pos)
+            if neg:
+                c_rew_neg.inc(neg)
+
+        def piggyback(step: int):
+            if not telemetry.enabled():
+                return None
+            if step == 0 or step % telemetry.PIGGYBACK_EVERY:
+                return None
+            return tracker.deltas() or None
+
+        return count_step, piggyback
+
     def _run_block_shm(self) -> None:
         import signal
 
@@ -290,6 +333,7 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
         dealer.setsockopt(zmq.IDENTITY, ident)
         dealer.connect(self.s2c)
 
+        count_step, piggyback = self._tele_setup()
         step = 0
         try:
             while True:
@@ -297,17 +341,19 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
                 # only the header + rewards + dones (the master rebuilds
                 # frame-history windows from ring slots — docs/actor_plane.md)
                 ring.arr[step % cap] = obs
+                meta = [ident, step, B, ring_name, cap, H, W, hist]
+                tele = piggyback(step)
+                if tele is not None:
+                    meta.append(tele)  # length-versioned (telemetry/wire.py)
                 push.send_multipart(
-                    pack_block(
-                        [ident, step, B, ring_name, cap, H, W, hist],
-                        [rewards, dones],
-                    ),
+                    pack_block(meta, [rewards, dones]),
                     copy=False,
                 )
                 actions = np.frombuffer(dealer.recv(), np.int32)
                 obs, rew, dn = env.step(actions)
                 rewards[:] = rew
                 dones[:] = dn
+                count_step(rew, dn)
                 step += 1
         except (KeyboardInterrupt, SystemExit, zmq.ContextTerminated):
             pass
@@ -341,24 +387,28 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
         dealer.setsockopt(zmq.IDENTITY, ident)
         dealer.connect(self.s2c)
 
+        count_step, piggyback = self._tele_setup()
         step = 0
         try:
             while True:
+                meta = [ident, step, B]
+                tele = piggyback(step)
+                if tele is not None:
+                    meta.append(tele)  # length-versioned (telemetry/wire.py)
                 # copy=False hands zmq the arrays' own buffers. Safe ONLY
                 # because the protocol is lockstep: the master cannot reply
                 # with actions before it has received (= fully copied out of
                 # this process over ipc/tcp) the observation message, and we
                 # do not mutate the buffers until that reply arrives.
                 push.send_multipart(
-                    pack_block(
-                        [ident, step, B], [stacks, rewards, dones]
-                    ),
+                    pack_block(meta, [stacks, rewards, dones]),
                     copy=False,
                 )
                 actions = np.frombuffer(dealer.recv(), np.int32)
                 obs, rew, dn = env.step(actions)
                 rewards[:] = rew
                 dones[:] = dn
+                count_step(rew, dn)
                 # shift history (contiguous memmove); clear across episode
                 # boundaries so the first post-reset state is [0,...,0,obs]
                 stacks[:-1] = stacks[1:]
@@ -399,21 +449,30 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
             s.connect(self.s2c)
             dealers.append(s)
 
+        count_step, piggyback = self._tele_setup()
         actions = np.zeros(B, np.int32)
+        step = 0
         try:
             while True:
+                tele = piggyback(step)
                 # the per-env wire IS the A6 antipattern — kept on purpose
                 # as the compat/correctness foil (`--wire per-env`); the
-                # block path above is the production wire
+                # block path above is the production wire. Telemetry rides
+                # env 0's message as an optional 5th element.
                 for i in range(B):
+                    msg = [idents[i], stacks[i], float(rewards[i]), bool(dones[i])]
+                    if i == 0 and tele is not None:
+                        msg.append(tele)
                     push.send(  # ba3clint: disable=A6 — compat foil, see docstring
-                        dumps([idents[i], stacks[i], float(rewards[i]), bool(dones[i])])
+                        dumps(msg)
                     )
                 for i in range(B):
                     actions[i] = loads(dealers[i].recv())  # ba3clint: disable=A6 — compat foil
                 obs, rew, dn = env.step(actions)
                 rewards[:] = rew
                 dones[:] = dn.astype(bool)
+                count_step(rew, dn)
+                step += 1
                 # shift history; clear across episode boundaries
                 stacks[..., :-1] = stacks[..., 1:]
                 stacks[..., -1] = obs

@@ -596,12 +596,25 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
         model, cfg, mesh, env, n_eval, max_steps=args.eval_max_steps
     )
 
+    # telemetry scrape endpoint (docs/observability.md): the fused loop has
+    # no actor plane, but its learner counters + flight ring are still the
+    # run's live view (--telemetry_port)
+    from distributed_ba3c_tpu import telemetry
+
+    tele_server = None
+    if getattr(args, "telemetry_port", 0):
+        tele_server = telemetry.TelemetryServer(args.telemetry_port)
+        tele_server.start()
     try:
         _fused_epoch_loop(
             args, cfg, step, state, holder, ckpt, samples_per_iter,
             n_envs, sched, evaluate,
         )
     finally:
+        if tele_server is not None:
+            tele_server.stop()
+            tele_server.join(timeout=2)
+            tele_server.close()
         holder.close()
     return 0
 
@@ -677,8 +690,14 @@ def _fused_epoch_body(
     args, cfg, step, state, holder, ckpt, samples_per_iter, n_envs, sched,
     evaluate, epoch0, live_hyper, beta_mode, lr_mode, watchdog,
 ):
+    from distributed_ba3c_tpu import telemetry
     from distributed_ba3c_tpu.utils import logger
 
+    tele = telemetry.registry("learner")
+    c_steps = tele.counter("train_steps_total")
+    c_samples = tele.counter("train_samples_total")
+    c_episodes = tele.counter("episodes_total")
+    h_epoch = tele.histogram("epoch_s", unit=1e-3)
     best = -np.inf
     first_eval_done = False
     for epoch in range(epoch0 + 1, args.max_epoch + 1):
@@ -696,6 +715,12 @@ def _fused_epoch_body(
         watchdog.beat()
         dt = time.monotonic() - t0
         fps = args.steps_per_epoch * samples_per_iter / dt
+        # one batched account per epoch window (the loop's own dispatch
+        # cadence) — scrape-visible progress without per-step host syncs
+        c_steps.inc(args.steps_per_epoch)
+        c_samples.inc(args.steps_per_epoch * samples_per_iter)
+        c_episodes.inc(int(metrics["episodes"]))
+        h_epoch.observe(dt)
         mean_ret = (
             metrics["episode_return_sum"] / metrics["episodes"]
             if metrics["episodes"] > 0
@@ -757,6 +782,9 @@ def _fused_epoch_body(
             )
         for k in ("loss", "policy_loss", "value_loss", "entropy", "grad_norm"):
             holder.add_stat(k, metrics[k])
+        if telemetry.enabled():
+            # same series the scrape endpoint serves, into stat.json/TB
+            holder.add_stats(telemetry.export_scalars(roles=("learner",)))
         holder.finalize()
         logger.info(
             "epoch %d | env-steps/s %.0f | mean_score %.2f (%d eps) | eval %.2f | loss %.4f entropy %.3f",
@@ -771,6 +799,7 @@ def _fused_epoch_body(
         # epoch-boundary checkpoint: the fetch is the save's payload, once
         # per epoch — not a per-step sync
         ckpt.save(jax.device_get(state.train), int(state.train.step))  # ba3clint: disable=J1
+        telemetry.record("checkpoint", step=int(state.train.step))
         # keep-best on GREEDY EVAL (not training-policy returns): the
         # reference's MaxSaver tracked the Evaluator's number
         if np.isfinite(eval_mean) and eval_mean > best:

@@ -155,6 +155,19 @@ class LockstepWatchdog:
                     "--load on the shared checkpoint dir to resume.",
                     self.what, stalled, limit, EXIT_CODE,
                 )
+                try:
+                    # postmortem before the hard exit: os._exit skips every
+                    # atexit/finally, so this is the run's LAST chance to
+                    # leave evidence (telemetry/recorder.py)
+                    from distributed_ba3c_tpu import telemetry
+
+                    telemetry.record(
+                        "watchdog", what=self.what,
+                        stalled_s=round(stalled, 1), limit_s=round(limit, 1),
+                    )
+                    telemetry.dump("watchdog kill")
+                except Exception:
+                    pass  # the exit must happen regardless
                 # flush logs before the hard exit
                 for h in getattr(logger._LOGGER, "handlers", []):
                     try:

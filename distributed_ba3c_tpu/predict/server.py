@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_ba3c_tpu import telemetry
 from distributed_ba3c_tpu.audit import tripwire_jit
 from distributed_ba3c_tpu.utils.concurrency import (
     StoppableThread,
@@ -125,6 +126,26 @@ class BatchedPredictor:
         self._greedy = greedy
         self._stop_evt = threading.Event()
 
+        # telemetry (docs/observability.md): serving-side counters live in
+        # the predictor role registry; the bucket-occupancy histogram is
+        # what separates "tiny fragmented batches" from "full buckets"
+        # when the plane slows down. Unit=1: occupancies are row counts.
+        tele = telemetry.registry("predictor")
+        self._c_batches = tele.counter("batches_total")
+        self._c_rows = tele.counter("rows_total")
+        self._c_oversize = tele.counter("blocks_oversize_total")
+        self._c_publishes = tele.counter("param_publishes_total")
+        self._c_chunked = tele.counter("chunked_calls_total")
+        self._c_chunks = tele.counter("chunks_total")
+        self._h_occupancy = tele.histogram("batch_rows", unit=1)
+        import weakref
+
+        ref = weakref.ref(self)
+        tele.gauge(
+            "task_queue_depth",
+            fn=lambda: p._queue.qsize() if (p := ref()) else 0,
+        )
+
         # registered audit entry point (distributed_ba3c_tpu/audit.py).
         # auto_arm=False: the pow-2 bucket warmup is a LEGITIMATE multi-shape
         # compile sequence; warmup() arms the tripwire when it completes, so
@@ -173,6 +194,7 @@ class BatchedPredictor:
     def update_params(self, params) -> None:
         """Publish fresh weights (atomic ref swap; next batch uses them)."""
         self._params = params
+        self._c_publishes.inc()
 
     def put_task(
         self, state: np.ndarray, callback: Callable[[int, float, float], None]
@@ -197,6 +219,7 @@ class BatchedPredictor:
         :meth:`put_task`."""
         cap = _next_pow2(max(self._batch_size, 1))
         if states.shape[0] > cap:
+            self._c_oversize.inc()
             raise ValueError(
                 f"block of {states.shape[0]} states exceeds the serving "
                 f"bucket ({cap}) — raise predict_batch_size to at least "
@@ -276,6 +299,11 @@ class BatchedPredictor:
             self._dispatch(params, states[i:i + cap])
             for i in range(0, states.shape[0], cap)
         ]
+        # chunking is worth SEEING on the scrape endpoint: a persistently
+        # chunked caller (Evaluator sized past the bucket) serializes
+        # fetches and should resize instead (docs/observability.md)
+        self._c_chunked.inc()
+        self._c_chunks.inc(len(pending))
         parts = [self._unpack(np.asarray(packed), k) for k, packed in pending]
         return tuple(np.concatenate(p) for p in zip(*parts))
 
@@ -314,6 +342,12 @@ class BatchedPredictor:
 
     def _serve_group(self, tasks) -> None:
         """One device call for a ≤-bucket group of tasks."""
+        # counted HERE (not _run_device) so the null-device bench predictor,
+        # which overrides _run_device, keeps the same series
+        n_rows = sum(tk.k if isinstance(tk, _BlockTask) else 1 for tk in tasks)
+        self._c_batches.inc()
+        self._c_rows.inc(n_rows)
+        self._h_occupancy.observe(n_rows)
         singles = [tk for tk in tasks if not isinstance(tk, _BlockTask)]
         blocks = [tk for tk in tasks if isinstance(tk, _BlockTask)]
         rows = []

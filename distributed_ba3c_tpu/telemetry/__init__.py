@@ -1,0 +1,59 @@
+"""Fleet telemetry plane (docs/observability.md).
+
+Four layers over the PR-4 actor plane:
+
+1. **Metrics core** (telemetry/metrics.py) — GIL-atomic per-thread-sharded
+   Counter/Gauge/log2 Histogram, one :class:`Registry` per role (master,
+   predictor, learner, simulator, fleet). No locks on increment;
+   aggregation at read time — cheap enough for the 52.8k env-steps/s/host
+   hot path (<=2% overhead, gated by ``scripts/plane_bench.py``).
+2. **Flight recorder** (telemetry/recorder.py) — fixed-size ring of
+   structured events, dumped as postmortem JSON on SanitizerError /
+   AuditError / watchdog kill / SIGTERM / plane failure events.
+3. **Fleet aggregation** (telemetry/wire.py) — simulator servers piggyback
+   counter deltas on the existing wire headers (length-versioned; old
+   headers still parse); the master folds them into the ``fleet`` registry.
+4. **Exporters** (telemetry/exporters.py) — ``--telemetry_port`` scrape
+   endpoint (Prometheus text + /json + /flight) and the stat.json/TB
+   bridge StatPrinter uses.
+
+The usual import is the package itself::
+
+    from distributed_ba3c_tpu import telemetry
+    steps = telemetry.registry("master").counter("env_steps_total")
+    steps.inc(B)
+    telemetry.record("prune", ident=str(ident))
+"""
+
+from __future__ import annotations
+
+from distributed_ba3c_tpu.telemetry.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    all_registries,
+    all_snapshots,
+    enabled,
+    registry,
+    reset_all,
+    set_enabled,
+)
+from distributed_ba3c_tpu.telemetry.recorder import (  # noqa: F401
+    FlightRecorder,
+    configure,
+    dump,
+    flight_recorder,
+    install_signal_dump,
+    record,
+)
+from distributed_ba3c_tpu.telemetry.exporters import (  # noqa: F401
+    TelemetryServer,
+    export_scalars,
+    prometheus_text,
+)
+from distributed_ba3c_tpu.telemetry.wire import (  # noqa: F401
+    PIGGYBACK_EVERY,
+    DeltaTracker,
+    apply_fleet_deltas,
+)

@@ -354,19 +354,33 @@ class StatPrinter(Callback):
     loss/policy_loss/value_loss/entropy/grad_norm, mean_score/max_score, fps.
     Device scalars are only fetched every ``sample_every`` steps so the hot
     loop stays async.
+
+    Throughput accounting reads the LEARNER REGISTRY (Trainer.run_step's
+    ``train_samples_total`` counter — docs/observability.md): one account
+    of progress, shared with the scrape endpoint, instead of a parallel
+    step count kept here. The epoch record also absorbs the telemetry
+    scalars (``tele/<role>/<name>``) so stat.json/TB dashboards see the
+    same series scrapers do.
     """
 
     def __init__(self, sample_every: int = 20):
         self.sample_every = sample_every
         self._counters: Dict[str, list] = {}
         self._epoch_t0 = None
-        self._epoch_steps = 0
+        self._last_samples = 0.0
+        self._last_gstep = 0
 
     def before_train(self):
+        from distributed_ba3c_tpu import telemetry
+
         self._epoch_t0 = time.monotonic()
+        self._samples_counter = telemetry.registry("learner").counter(
+            "train_samples_total"
+        )
+        self._last_samples = self._samples_counter.value()
+        self._last_gstep = self.trainer.global_step
 
     def trigger_step(self, metrics):
-        self._epoch_steps += 1
         if metrics is None or self.trainer.global_step % self.sample_every:
             return
         fetched = {k: float(v) for k, v in metrics.items()}
@@ -374,10 +388,19 @@ class StatPrinter(Callback):
             self._counters.setdefault(k, []).append(v)
 
     def trigger_epoch(self):
+        from distributed_ba3c_tpu import telemetry
+
         tr = self.trainer
         holder = tr.stat_holder
         dt = time.monotonic() - self._epoch_t0 if self._epoch_t0 else 0.0
-        samples = self._epoch_steps * tr.batch_size
+        samples = self._samples_counter.value() - self._last_samples
+        self._last_samples += samples
+        if not telemetry.enabled():
+            # BA3C_TELEMETRY=0: the counters are no-ops — fall back to the
+            # loop's own step counter (global_step is loop state, not a
+            # metric; no dual accounting re-enters here)
+            samples = (tr.global_step - self._last_gstep) * tr.batch_size
+        self._last_gstep = tr.global_step
         fps = samples / dt if dt > 0 else 0.0
         holder.add_stat("global_step", tr.global_step)
         holder.add_stat("epoch", tr.epoch_num)
@@ -390,6 +413,10 @@ class StatPrinter(Callback):
             holder.add_stat("max_score", tr.score_counter.max)
             tr.last_mean_score = tr.score_counter.average
             tr.score_counter.reset()
+        if telemetry.enabled():
+            # periodic export: the same series the scrape endpoint serves,
+            # folded into stat.json/TB so existing dashboards keep working
+            holder.add_stats(telemetry.export_scalars())
         record = holder.finalize()
         logger.info(
             "epoch %d | step %d | fps %.0f | %s",
@@ -399,11 +426,12 @@ class StatPrinter(Callback):
             " ".join(
                 f"{k}={v:.4g}"
                 for k, v in record.items()
+                # tele/ series go to stat.json/TB/scrape, not the console
                 if k not in ("epoch", "global_step", "fps")
+                and not k.startswith("tele/")
             ),
         )
         self._counters = {}
-        self._epoch_steps = 0
         self._epoch_t0 = time.monotonic()
 
 
@@ -433,6 +461,9 @@ class ModelSaver(Callback):
             path = self.trainer.ckpt_manager.save(
                 self.trainer.state, self.trainer.global_step
             )
+            from distributed_ba3c_tpu import telemetry
+
+            telemetry.record("checkpoint", step=self.trainer.global_step)
             if self.trainer.is_chief:
                 logger.info("saved checkpoint %s", path)
 

@@ -19,12 +19,14 @@ from __future__ import annotations
 
 import dataclasses
 import queue
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_ba3c_tpu import telemetry
 from distributed_ba3c_tpu.config import BA3CConfig
 from distributed_ba3c_tpu.train.callbacks import Callback, Callbacks
 from distributed_ba3c_tpu.utils import logger
@@ -84,6 +86,14 @@ class Trainer:
         self.metrics = None
         self._callbacks = Callbacks(callbacks)
 
+        # telemetry (docs/observability.md): the learner registry is the
+        # single account of training progress — StatPrinter derives its fps
+        # from these counters instead of keeping its own step count
+        tele = telemetry.registry("learner")
+        self._c_steps = tele.counter("train_steps_total")
+        self._c_samples = tele.counter("train_samples_total")
+        self._h_step = tele.histogram("step_s", unit=1e-6)
+
     # -- predictor glue ----------------------------------------------------
     def predictor_fn(self) -> Callable[[np.ndarray], np.ndarray]:
         """Greedy batched predict on CURRENT params (for Evaluator)."""
@@ -141,6 +151,7 @@ class Trainer:
         # and reverted: it could starve at shutdown and discard the final
         # step's accounting). The overlap is bounded by trigger_step
         # callbacks that fetch metrics (StatPrinter samples every N steps).
+        t0 = time.monotonic()
         batch = self._next_device_batch()
         self.state, self.metrics = self.step_fn(
             self.state,
@@ -149,6 +160,11 @@ class Trainer:
             self.hyperparams["learning_rate"],
         )
         self.global_step += 1
+        # step latency here covers feed wait + staging + async dispatch —
+        # the host-side budget (device execution overlaps the next call)
+        self._h_step.observe(time.monotonic() - t0)
+        self._c_steps.inc()
+        self._c_samples.inc(self.batch_size)
         if self.global_step % self.config.publish_every == 0:
             self._publish_params()
         self._drain_scores()

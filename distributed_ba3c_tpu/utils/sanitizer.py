@@ -59,6 +59,15 @@ def reset() -> None:
 def _report(msg: str) -> None:
     with _findings_lock:
         _findings.append(msg)
+    try:
+        # postmortem BEFORE the raise: the exception may be swallowed by a
+        # worker thread, but the flight dump survives on disk either way
+        from distributed_ba3c_tpu import telemetry
+
+        telemetry.record("sanitizer", finding=msg)
+        telemetry.dump("SanitizerError")
+    except Exception:
+        pass  # telemetry must never mask the finding itself
     raise SanitizerError(msg)
 
 

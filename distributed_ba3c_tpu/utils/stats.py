@@ -81,6 +81,13 @@ class StatHolder:
     def add_stat(self, name: str, value: float) -> None:
         self.stat_now[name] = float(value)
 
+    def add_stats(self, values: Dict[str, float]) -> None:
+        """Bulk :meth:`add_stat` — the telemetry bridge's entry point
+        (StatPrinter folds ``telemetry.export_scalars()`` in per epoch, so
+        stat.json/TB carry the same series the scrape endpoint serves)."""
+        for name, v in values.items():
+            self.stat_now[name] = float(v)
+
     def finalize(self) -> Dict[str, float]:
         """Close the epoch: append the record, write stat.json + TB events."""
         record = dict(self.stat_now)

@@ -1,0 +1,28 @@
+"""A7-clean: the idioms the real codebase uses — registry metrics, logger
+output, and wall-clock timestamps only where they leave the process."""
+
+import time
+
+from distributed_ba3c_tpu import telemetry
+
+_steps = telemetry.registry("master").counter("env_steps_total")
+_wait = telemetry.registry("master").histogram("queue_put_wait_s", unit=1e-6)
+
+
+def account(n: int, waited_s: float) -> None:
+    # metric accounting through the registry: scrape/stat.json/fleet all
+    # see it, and the internals are monotonic
+    _steps.inc(n)
+    _wait.observe(waited_s)
+
+
+def export_record(channel: str, value: float) -> dict:
+    # a wall timestamp that LEAVES the process (experiment log) is what
+    # time.time() is for
+    return {"channel": channel, "value": value, "ts": time.time()}
+
+
+def console(logger, epoch: int, score: float) -> None:
+    # logger output (not print), no hand-rolled rate math
+    logger.info("epoch %d | score %.2f", epoch, score)
+    print("episode finished with score", score)  # non-metric print is fine

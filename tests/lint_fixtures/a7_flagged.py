@@ -1,0 +1,22 @@
+"""A7 fixture: ad-hoc metric accounting that belongs in the telemetry
+registry (docs/observability.md). Every pattern here is invisible to the
+scrape endpoint, stat.json and the fleet series."""
+
+import time
+
+
+class Plane:
+    def __init__(self, q):
+        self.q = q
+        self.n = 0
+        self.started = time.monotonic()
+
+    def report(self):
+        # time.time()-based rate math (also wall-clock — A4's territory)
+        fps = self.n / (time.time() - self.started)
+        # print-based metric reporting: f-string fragment
+        print(f"plane fps {fps:.1f}")
+        # print-based metric reporting: plain-string fragment
+        print("train queue qsize:", self.q.qsize())
+        # print-based metric reporting: rate-unit fragment
+        print("serving " + str(self.n) + " env-steps/sec")

@@ -19,7 +19,7 @@ from tools.ba3clint.engine import suppressions
 FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-RULE_IDS = ["J1", "J2", "J3", "J4", "J5", "A1", "A2", "A3", "A4", "A5", "A6"]
+RULE_IDS = ["J1", "J2", "J3", "J4", "J5", "A1", "A2", "A3", "A4", "A5", "A6", "A7"]
 
 
 def _fixture(name):
@@ -67,6 +67,19 @@ def test_expected_flag_counts():
     assert len(_findings("j3_flagged.py", "J3")) == 3
     assert len(_findings("a2_flagged.py", "A2")) == 2
     assert len(_findings("a6_flagged.py", "A6")) == 3
+    assert len(_findings("a7_flagged.py", "A7")) == 4
+
+
+def test_a7_exempts_telemetry_package(tmp_path):
+    """The registry's own implementation may use print/time.time freely."""
+    d = tmp_path / "telemetry"
+    d.mkdir()
+    f = d / "exporters.py"
+    f.write_text("import time\nfps = 3 / (time.time() - 1)\nprint('fps', fps)\n")
+    assert [x for x in lint_file(str(f), all_rules()) if x.rule == "A7"] == []
+    g = tmp_path / "loop.py"
+    g.write_text("import time\nfps = 3 / (time.time() - 1)\n")
+    assert [x for x in lint_file(str(g), all_rules()) if x.rule == "A7"]
 
 
 def test_suppressions_silence_real_violations():

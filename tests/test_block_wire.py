@@ -9,6 +9,7 @@ a transport optimization and must be invisible to the learner.
 
 from __future__ import annotations
 
+import json
 import queue
 import tempfile
 import threading
@@ -17,6 +18,7 @@ import time
 import numpy as np
 import pytest
 
+from distributed_ba3c_tpu import telemetry
 from distributed_ba3c_tpu.actors.master import BA3CSimulatorMaster
 from distributed_ba3c_tpu.actors.simulator import (
     BlockClientState,
@@ -28,6 +30,12 @@ from distributed_ba3c_tpu.utils.concurrency import FastQueue
 from distributed_ba3c_tpu.utils.serialize import pack_block, unpack_block
 
 N_ACTIONS = 4
+
+
+def _counter(name: str) -> float:
+    """Current value of a master-registry counter (registries are
+    process-global, so tests assert DELTAS around the scenario)."""
+    return telemetry.registry("master").counter(name).value()
 
 
 def _policy(state: np.ndarray):
@@ -417,6 +425,7 @@ def test_block_restart_resets_client_state(tmp_path):
         b, h, w, hist = 2, 8, 8, 2
         obs = np.zeros((hist, b, h, w), np.uint8)
         rew, dn = np.zeros(b, np.float32), np.zeros(b, np.uint8)
+        resets0 = _counter("incarnation_resets_total")
         for step in (0, 1, 2):
             m._on_block_frames(_wire_frames([ident, step, b], [obs, rew, dn]))
         blk = m.clients[ident]
@@ -427,6 +436,8 @@ def test_block_restart_resets_client_state(tmp_path):
         assert blk2 is not blk, "restart must create a fresh incarnation"
         assert blk2.last_step == 0 and len(blk2.steps) == 1
         assert (blk2.scores == 0).all()
+        # the failure is ACCOUNTED, not just handled (docs/observability.md)
+        assert _counter("incarnation_resets_total") == resets0 + 1
     finally:
         m.close()
 
@@ -445,8 +456,11 @@ def test_block_shm_misconfig_drops_client_not_master(tmp_path):
         frames = _wire_frames(
             meta, [np.zeros(4, np.float32), np.zeros(4, np.uint8)]
         )
+        dropped0 = _counter("clients_dropped_total")
         m._on_block_frames(frames)  # must swallow the ValueError
         assert ident not in m.clients
+        # the refusal ticked the drop counter (docs/observability.md)
+        assert _counter("clients_dropped_total") == dropped0 + 1
     finally:
         m.close()
 
@@ -464,6 +478,7 @@ def test_malformed_block_message_skipped_not_fatal(tmp_path):
         obs = np.zeros((hist, b, h, w), np.uint8)
         rew, dn = np.zeros(b, np.float32), np.zeros(b, np.uint8)
         good = _wire_frames([b"x*block", 0, b], [obs, rew, dn])
+        rejected0 = _counter("blocks_rejected_total")
         # header is not valid msgpack at all
         m._on_block_frames([_WireFrame(b"\xc1garbage"), _WireFrame(b"")])
         # header declares more arrays than the message carries
@@ -475,6 +490,8 @@ def test_malformed_block_message_skipped_not_fatal(tmp_path):
             _wire_frames([b"z*block", 0, b + 1], [obs, rew, dn])
         )
         assert not m.clients, "malformed messages must not create clients"
+        # every rejection was ACCOUNTED (docs/observability.md)
+        assert _counter("blocks_rejected_total") == rejected0 + 4
         m._on_block_frames(good)  # the loop is still alive and serving
         assert b"x*block" in m.clients
     finally:
@@ -543,6 +560,9 @@ def _block_sender_thread(c2s, s2c, ident, n_steps, stop_evt):
 
 
 def test_block_client_pruned_after_server_death(tmp_path):
+    import os
+
+    telemetry.configure(str(tmp_path))  # flight dumps land here
     c2s, s2c = f"ipc://{tmp_path}/c2s", f"ipc://{tmp_path}/s2c"
     m = BA3CSimulatorMaster(
         c2s, s2c, _DetPredictor(), gamma=0.5, local_time_max=3,
@@ -554,6 +574,7 @@ def test_block_client_pruned_after_server_death(tmp_path):
         target=_block_sender_thread, args=(c2s, s2c, ident, 5, done_evt),
         daemon=True,
     )
+    pruned0 = _counter("clients_pruned_total")
     m.start()
     t.start()
     try:
@@ -568,7 +589,17 @@ def test_block_client_pruned_after_server_death(tmp_path):
         while ident in m.clients and time.monotonic() < deadline:
             time.sleep(0.2)
         assert ident not in m.clients, "dead block client never pruned"
+        # the prune TICKED its counter and left a postmortem flight dump
+        # containing the prune event (the ISSUE-5 acceptance scenario)
+        assert _counter("clients_pruned_total") == pruned0 + 1
+        dump_path = str(tmp_path / f"flight-{os.getpid()}.json")
+        assert os.path.isfile(dump_path), "prune left no flight dump"
+        doc = json.load(open(dump_path))
+        assert doc["reason"] == "actor prune"
+        prunes = [e for e in doc["events"] if e["kind"] == "prune"]
+        assert prunes and repr(ident) in prunes[-1]["ident"]
     finally:
+        telemetry.configure(None)
         m.close()
         t.join(timeout=5)
 

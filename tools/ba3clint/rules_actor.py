@@ -8,6 +8,7 @@ worked examples live in docs/static_analysis.md.
 from __future__ import annotations
 
 import ast
+import os
 from typing import Dict, Iterator, List, Set
 
 from tools.ba3clint.engine import (
@@ -424,6 +425,103 @@ class PerEnvWireLoopRule(Rule):
         return isinstance(root, ast.Name) and root.id in targets
 
 
+#: identifier TOKENS (underscore-split) that mark a statement as metric
+#: accounting. Whole tokens, not substrings: "rate" must catch `msg_rate`
+#: without firing on `learning_rate`-adjacent timestamps via `generate`/
+#: `iterate`/`separate` — except learning_rate itself, which token
+#: matching would also hit; it is a hyperparameter, not a metric, so it
+#: is exempted explicitly below.
+_METRIC_NAME_TOKENS = frozenset(
+    ("fps", "rate", "throughput", "latency", "persec")
+)
+_NON_METRIC_NAMES = frozenset(("learning_rate", "lr_rate"))
+#: literal-string fragments that mark a print as metric reporting
+_METRIC_STRING_FRAGMENTS = (
+    "fps", "steps/s", "steps/sec", "/sec", "per sec", "throughput",
+    "latency", "qsize",
+)
+
+
+def _string_literals(call: ast.Call) -> Iterator[str]:
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                yield sub.value
+            elif isinstance(sub, ast.JoinedStr):
+                for v in sub.values:
+                    if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                        yield v.value
+
+
+class AdhocMetricRule(Rule):
+    """A7: ``time.time()``/``print``-based metric accounting outside
+    ``telemetry/``.
+
+    The telemetry plane (distributed_ba3c_tpu/telemetry/,
+    docs/observability.md) is THE account of rates, latencies and queue
+    depths: registry counters feed the scrape endpoint, the stat.json/TB
+    bridge, and the fleet piggyback at once. A hand-rolled
+    ``fps = n / (time.time() - t0)`` + ``print(...)`` is invisible to all
+    three — and wall-clock-based on top (see A4). Route the number through
+    ``telemetry.registry(role)`` (Counter/Gauge/Histogram) and let the
+    exporters render it; ``print`` stays fine for non-metric output, and
+    the rule does not apply inside ``telemetry/`` itself (something has to
+    implement the plane).
+    """
+
+    id = "A7"
+    name = "adhoc-metric"
+    summary = "ad-hoc time.time()/print metric accounting bypasses the telemetry registry"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if "telemetry" in ctx.path.replace(os.sep, "/").split("/"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name) and node.func.id == "print":
+                for s in _string_literals(node):
+                    low = s.lower()
+                    if any(f in low for f in _METRIC_STRING_FRAGMENTS):
+                        yield ctx.finding(
+                            self, node,
+                            "print-based metric reporting — route it "
+                            "through telemetry.registry(...) so the scrape "
+                            "endpoint / stat.json / fleet series see it",
+                        )
+                        break
+            elif ctx.info.resolve(node.func) == "time.time":
+                stmt = enclosing_statement(node)
+                if stmt is not None and self._stmt_mentions_metric(stmt):
+                    yield ctx.finding(
+                        self, node,
+                        "time.time()-based metric accounting — use a "
+                        "telemetry registry Counter/Histogram (monotonic "
+                        "inside) instead of hand-rolled rate math",
+                    )
+
+    @staticmethod
+    def _stmt_mentions_metric(stmt: ast.stmt) -> bool:
+        for sub in ast.walk(stmt):
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            if not name:
+                continue
+            low = name.lower()
+            if low in _NON_METRIC_NAMES:
+                continue
+            tokens = low.split("_")
+            if not _METRIC_NAME_TOKENS.isdisjoint(tokens):
+                return True
+            # "per_sec"/"persec" may straddle a token boundary
+            if "persec" in low.replace("_", ""):
+                return True
+        return False
+
+
 ACTOR_RULES = [
     BareThreadRule(),
     BlockingQueueOpRule(),
@@ -431,4 +529,5 @@ ACTOR_RULES = [
     WallClockArithRule(),
     PrivateImportRule(),
     PerEnvWireLoopRule(),
+    AdhocMetricRule(),
 ]
