@@ -248,9 +248,10 @@ def _master_progress() -> tuple:
     return msgs, s.get("datapoints_total", 0)
 
 
-def _stall_attribution() -> str:
+def stall_attribution() -> str:
     """Name the dead stage from the real counters (the bare time threshold
-    used to be the whole diagnosis; now it only opens the case)."""
+    used to be the whole diagnosis; now it only opens the case). Public:
+    scripts/chaos_bench.py attributes its own warmup failures with it."""
     from distributed_ba3c_tpu import telemetry
 
     m = telemetry.registry("master").scalars()
@@ -272,6 +273,11 @@ def _stall_attribution() -> str:
     if dps == 0:
         return f"predictor serving but no datapoints: flush path stalled ({parts})"
     return f"plane went quiet after progress ({parts})"
+
+
+#: private alias kept so staged callers keep working (same
+#: convention as devicelock.stderr_print)
+_stall_attribution = stall_attribution
 
 
 def bench_zmq_plane(
@@ -351,7 +357,10 @@ def bench_zmq_plane(
     )
     per = envs_per_proc
     procs = [
-        native.CppEnvServerProcess(
+        # the RAW unsupervised plane is the measurand here (no respawn
+        # machinery in the loop); the supervised path has its own
+        # instrument, scripts/chaos_bench.py
+        native.CppEnvServerProcess(  # ba3clint: disable=A8
             i, c2s, s2c, game=game, n_envs=min(per, n_envs - i * per),
             wire=wire,
         )
@@ -379,7 +388,7 @@ def bench_zmq_plane(
             # difference between a mystery and a diagnosis when a fleet
             # shape fails to come up (docs/observability.md)
             raise RuntimeError(
-                f"plane produced no warmup data — {_stall_attribution()}"
+                f"plane produced no warmup data — {stall_attribution()}"
             ) from None
         window_rates = []
         q = master.queue
@@ -423,7 +432,7 @@ def bench_zmq_plane(
                         raise RuntimeError(
                             "plane stalled: "
                             f"{min(5.0, seconds / 2):.1f}s without data "
-                            f"post-warmup — {_stall_attribution()}"
+                            f"post-warmup — {stall_attribution()}"
                         )
                     time.sleep(0.002)
             window_rates.append(n / (time.perf_counter() - t0))

@@ -29,6 +29,11 @@ from distributed_ba3c_tpu.utils.concurrency import FastQueue
 class BA3CSimulatorMaster(SimulatorMaster):
     """Feeds the training queue with [state, action, n-step return] triples."""
 
+    # fleet_snapshot conversion factor: each queued item is ONE
+    # (state, action, R) datapoint, so queue depth already IS the sample
+    # backlog (actors/simulator.py documents the field's contract)
+    queue_samples_per_item = 1
+
     def __init__(
         self,
         pipe_c2s: str,

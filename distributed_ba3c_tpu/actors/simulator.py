@@ -301,6 +301,15 @@ class SimulatorMaster(threading.Thread):
         self.c2s_socket.bind(pipe_c2s)
         self.c2s_socket.set_hwm(32)
         self.s2c_socket = self.context.socket(zmq.ROUTER)
+        # identity HANDOVER: a respawned env server reconnects with its
+        # dead predecessor's DEALER identity (slot-stable idents are what
+        # make restarts land as incarnation resets). Without handover,
+        # libzmq keeps the identity bound to the old half-dead pipe and
+        # REJECTS the new peer — the master's action replies then go
+        # nowhere and the respawned server parks in recv() forever (found
+        # by the chaos bench: under sustained kill/respawn every slot
+        # wedged one by one until the plane flatlined at zero).
+        self.s2c_socket.setsockopt(zmq.ROUTER_HANDOVER, 1)
         self.s2c_socket.bind(pipe_s2c)
         self.s2c_socket.set_hwm(32)
 
@@ -350,6 +359,17 @@ class SimulatorMaster(threading.Thread):
             "train_queue_depth",
             fn=lambda: (
                 q.qsize()
+                if (m := ref()) and (q := getattr(m, "queue", None))
+                else 0
+            ),
+        )
+        # capacity next to depth: an autoscaler (or any scraper) reading
+        # queue fill over HTTP needs both ends of the fraction on the
+        # endpoint — depth alone is meaningless without the bound
+        tele.gauge(
+            "train_queue_capacity",
+            fn=lambda: (
+                int(getattr(q, "maxsize", 0) or 0)
                 if (m := ref()) and (q := getattr(m, "queue", None))
                 else 0
             ),
@@ -430,6 +450,33 @@ class SimulatorMaster(threading.Thread):
             if not self._stop_evt.is_set():
                 raise
             logger.info("SimulatorMaster socket closed during shutdown")
+
+    #: how many env transitions one train-queue item represents — the
+    #: conversion factor a fleet_snapshot consumer needs to turn queue
+    #: depth into a sample backlog. (The shipped autoscaler policy works
+    #: on the unit-free fill fraction and does not need it; external
+    #: scrapers comparing depth against batch sizes do.) Subclasses own
+    #: the real value: BA3C 1 datapoint per item, V-trace unroll_len.
+    queue_samples_per_item: int = 1
+
+    def fleet_snapshot(self) -> dict:
+        """Fleet-size introspection hook (orchestrate/autoscaler.py).
+
+        One consistent read of the backpressure signals the autoscaler
+        feeds on, taken from the SAME telemetry counters the scrape
+        endpoint exports — the supervisor acts on the master's account of
+        the fleet, never on its own duplicate heartbeats. Safe from any
+        thread: every field is a GIL-atomic read or a sharded-counter sum.
+        """
+        q = getattr(self, "queue", None)
+        return {
+            "clients": len(self.clients),
+            "queue_depth": int(q.qsize()) if q is not None else 0,
+            "queue_maxsize": int(getattr(q, "maxsize", 0) or 0),
+            "queue_samples_per_item": int(self.queue_samples_per_item),
+            "blocked_puts_total": float(self._c_blocked_puts.value()),
+            "datapoints_total": float(self._c_datapoints.value()),
+        }
 
     def _prune_dead_actors(self) -> None:
         """Drop state of clients silent for > actor_timeout (actor loss is

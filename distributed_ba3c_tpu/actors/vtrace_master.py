@@ -69,6 +69,12 @@ class VTraceSimulatorMaster(SimulatorMaster):
         # that trails the newest written slot by a whole unroll — the ring
         # safety check must count T steps per queued item, not 1
         self.ring_steps_per_item = unroll_len
+        # fleet_snapshot conversion factor: a queued item is a whole
+        # unroll segment, so a consumer turning depth into a sample
+        # backlog must multiply by unroll_len — reading a V-trace queue
+        # as single datapoints undercounts it T-fold (actors/simulator.py
+        # documents the field's contract)
+        self.queue_samples_per_item = unroll_len
         # FastQueue for the same reason as BA3CSimulatorMaster: segment
         # emission rides the block wire's datapoint budget
         self.queue: queue.Queue = sanitizer.wrap_queue(

@@ -95,6 +95,25 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--anneal", default="linear", choices=["linear", "exp"], help="shape of the *_final anneals: linear or geometric (exp)")
     p.add_argument("--anneal_lr", default=None, choices=["linear", "exp"], help="override --anneal for learning_rate only (β and lr want different shapes: β drops early, lr holds through the mid-game)")
     p.add_argument("--anneal_beta", default=None, choices=["linear", "exp"], help="override --anneal for entropy_beta only")
+    # -- elastic fleet orchestration (docs/orchestration.md) ---------------
+    p.add_argument(
+        "--fleet_min", type=int, default=0,
+        help="autoscaler LOWER bound, in env-server processes (0 = the "
+        "launch size). Local fleets (cpp:/fake/gym:/jax:) only — external "
+        "zmq: fleets are supervised on their own hosts "
+        "(scripts/launch_env_fleet.py)",
+    )
+    p.add_argument(
+        "--fleet_max", type=int, default=0,
+        help="autoscaler UPPER bound, in env-server processes (0 = the "
+        "launch size). fleet_max > fleet_min enables the telemetry-driven "
+        "autoscaler: the fleet grows when the train queue starves and "
+        "shrinks under blocked-put backpressure (docs/orchestration.md)",
+    )
+    p.add_argument(
+        "--autoscale_interval", type=float, default=2.0,
+        help="seconds between autoscaler policy ticks",
+    )
     p.add_argument("--profiler_port", type=int, default=0, help="start jax.profiler server on this port (0=off)")
     p.add_argument("--telemetry_port", type=int, default=0, help="serve the telemetry scrape endpoint on this port (0=off): /metrics Prometheus text, /json raw snapshots, /flight the live flight-recorder ring (docs/observability.md)")
     p.add_argument("--pipe_c2s", default=None, help="master experience-plane bind address, e.g. tcp://0.0.0.0:5555 (default: per-pid ipc://)")
@@ -237,6 +256,22 @@ def main(argv: Optional[list] = None) -> int:
             f"--steps_per_dispatch {args.steps_per_dispatch} must divide "
             f"--steps_per_epoch {args.steps_per_epoch}"
         )
+    if args.fleet_min or args.fleet_max:
+        if args.task != "train" or args.env.startswith("zmq:"):
+            raise SystemExit(
+                "--fleet_min/--fleet_max size a LOCALLY-supervised env "
+                "fleet — external zmq: fleets are supervised on their own "
+                "hosts (scripts/launch_env_fleet.py), and eval/play spawn "
+                "no fleet"
+            )
+        if (
+            args.fleet_min
+            and args.fleet_max
+            and args.fleet_min > args.fleet_max
+        ):
+            raise SystemExit(
+                f"--fleet_min {args.fleet_min} > --fleet_max {args.fleet_max}"
+            )
 
     # Take the host-local TPU claim BEFORE the first jax backend touch: two
     # concurrent claimants don't error, they wedge the exclusive pool
@@ -436,9 +471,32 @@ def main(argv: Optional[list] = None) -> int:
         # ring-safety input: the feed's collate holder pins ring views too
         master.feed_batch = cfg.batch_size // n_hosts
         samples_per_step = cfg.batch_size
+    # Local fleets are owned by a FleetSupervisor (docs/orchestration.md):
+    # crashed/wedged servers respawn with backoff behind a restart-budget
+    # circuit breaker, stale shm rings are reclaimed at spawn, and
+    # --fleet_min/--fleet_max attach the telemetry-driven autoscaler.
+    from distributed_ba3c_tpu.orchestrate import (
+        Autoscaler,
+        FleetSpec,
+        FleetSupervisor,
+        master_signals,
+    )
+
+    def _fleet_bounds(n_servers: int) -> tuple:
+        lo = args.fleet_min or n_servers
+        hi = args.fleet_max or n_servers
+        if not lo <= n_servers <= hi:
+            raise SystemExit(
+                f"launch fleet size {n_servers} servers is outside "
+                f"[--fleet_min {lo}, --fleet_max {hi}] — size the launch "
+                "fleet (--simulator_procs) inside the bounds"
+            )
+        return lo, hi
+
+    supervisor = None
     if external_fleet:
-        # remote fleets own the envs; nothing to start locally
-        procs = []
+        # remote fleets own the envs; nothing to start (or supervise)
+        # locally — scripts/launch_env_fleet.py supervises on its host
         logger.info(
             "external-fleet mode: master pipes bound at %s (c2s) / %s (s2c) "
             "— waiting for env servers to connect", c2s, s2c,
@@ -492,24 +550,52 @@ def main(argv: Optional[list] = None) -> int:
                 int(need * 1.25) + 1,
             )
 
-        procs = [
-            native.CppEnvServerProcess(
+        n_servers = (total + per - 1) // per
+        lo, hi = _fleet_bounds(n_servers)
+
+        def cpp_factory(i):
+            # ragged last INITIAL slot keeps --simulator_procs exact;
+            # slots grown past it host the full block. Ring caps are
+            # sized per-slot from the run's actual buffering (above).
+            n = per
+            remaining = total - i * per
+            if 0 < remaining < n:
+                n = remaining
+            # construction only parameterizes the slot — the
+            # FleetSupervisor this factory is handed to owns the spawn
+            return native.CppEnvServerProcess(  # ba3clint: disable=A8
                 i,
                 c2s,
                 s2c,
                 game=game,
-                n_envs=min(per, total - i * per),
+                n_envs=n,
                 frame_history=cfg.frame_history,
                 wire=wire,
-                shm_ring_cap=ring_cap(min(per, total - i * per)),
+                shm_ring_cap=ring_cap(n),
             )
-            for i in range((total + per - 1) // per)
-        ]
+
+        supervisor = FleetSupervisor(
+            FleetSpec(
+                pipe_c2s=c2s, pipe_s2c=s2c, game=game, envs_per_server=per,
+                frame_history=cfg.frame_history, wire=wire,
+                fleet_size=n_servers, fleet_min=lo, fleet_max=hi,
+            ),
+            factory=cpp_factory,
+        )
     else:
-        procs = [
-            SimulatorProcess(i, c2s, s2c, sim_build_player)
-            for i in range(cfg.simulator_procs)
-        ]
+        lo, hi = _fleet_bounds(cfg.simulator_procs)
+        supervisor = FleetSupervisor(
+            FleetSpec(
+                pipe_c2s=c2s, pipe_s2c=s2c, envs_per_server=1,
+                frame_history=cfg.frame_history, wire="per-env",
+                fleet_size=cfg.simulator_procs, fleet_min=lo, fleet_max=hi,
+            ),
+            # same parameterize-only contract as cpp_factory above
+            factory=lambda i: SimulatorProcess(  # ba3clint: disable=A8
+                i, c2s, s2c, sim_build_player
+            ),
+            ident_prefix=lambda i: f"simulator-{i}",
+        )
 
     # Order matters: Evaluator adds its stats BEFORE StatPrinter finalizes the
     # epoch record, and MaxSaver reads the monitored stat from that record.
@@ -533,8 +619,21 @@ def main(argv: Optional[list] = None) -> int:
         if args.telemetry_port
         else []
     )
+    startables = [predictor, master, feed]
+    if supervisor is not None:
+        startables.append(supervisor)
+        if supervisor.spec.fleet_max > supervisor.spec.fleet_min:
+            # elastic bounds requested: the policy loop watches THIS
+            # master's backpressure signals (never its own heartbeats)
+            startables.append(
+                Autoscaler(
+                    supervisor,
+                    master_signals(master),
+                    interval_s=args.autoscale_interval,
+                )
+            )
     callbacks = [
-        StartProcOrThread([predictor, master, feed] + procs + tele_servers),
+        StartProcOrThread(startables + tele_servers),
         HumanHyperParamSetter("learning_rate", shared_dir=base_logdir),
         HumanHyperParamSetter("entropy_beta", shared_dir=base_logdir),
         StatPrinter(),

@@ -316,11 +316,11 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
         # pipe address): a crashed/SIGKILLed server leaves its ring file in
         # /dev/shm, and create()'s rename-over reclaims it only if the
         # replacement generates the SAME name — a pid in the name would
-        # leak ~57 MB per crash until /dev/shm fills (utils/shm.py)
-        import hashlib
+        # leak ~57 MB per crash until /dev/shm fills. The name formula is
+        # shared with the supervisor's stale-ring reclaim (utils/shm.py)
+        from distributed_ba3c_tpu.utils import shm as shm_mod
 
-        fleet = hashlib.sha1(self.c2s.encode()).hexdigest()[:8]
-        ring_name = f"ba3c-ring-{fleet}-{self.ident_prefix}"
+        ring_name = shm_mod.ring_name(self.c2s, self.ident_prefix)
         ring = ShmRing.create(ring_name, cap, B, H, W)
         rewards = np.zeros(B, np.float32)
         dones = np.zeros(B, np.uint8)

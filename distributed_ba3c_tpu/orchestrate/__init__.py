@@ -1,0 +1,44 @@
+"""Elastic self-healing fleet orchestration (docs/orchestration.md).
+
+The subsystem that ACTS on what the actor plane survives and the
+telemetry plane measures — ROADMAP open item 5:
+
+- :class:`FleetSpec` (orchestrate/spec.py) — the declarative fleet
+  description: env-server shape + sizing bounds + respawn policy.
+- :class:`FleetSupervisor` (orchestrate/supervisor.py) — spawns the
+  fleet, watches the master's telemetry account for deaths/wedges,
+  respawns with exponential backoff behind a restart-budget circuit
+  breaker, scales between ``fleet_min``/``fleet_max``.
+- :class:`Autoscaler` / :class:`AutoscalerPolicy`
+  (orchestrate/autoscaler.py) — the policy loop turning FastQueue
+  depth/blocked-put backpressure into scale decisions.
+- :class:`LearnerSupervisor` (orchestrate/learner.py) — checkpoint
+  failover: a killed learner resumes from the last finalized checkpoint
+  without operator action (``python -m distributed_ba3c_tpu.orchestrate``).
+- :class:`ChaosMonkey` (orchestrate/chaos.py) — the acceptance harness's
+  fault injector (scripts/chaos_bench.py gates on >=90% of no-chaos
+  throughput under random SIGKILLs).
+
+Every decision is exported as ``tele/orchestrator/*`` series and
+flight-recorder events — scale/respawn/failover actions are always
+postmortem-visible.
+"""
+
+from __future__ import annotations
+
+from distributed_ba3c_tpu.orchestrate.autoscaler import (  # noqa: F401
+    Autoscaler,
+    AutoscalerPolicy,
+    http_signals,
+    master_signals,
+)
+from distributed_ba3c_tpu.orchestrate.chaos import ChaosMonkey  # noqa: F401
+from distributed_ba3c_tpu.orchestrate.learner import (  # noqa: F401
+    LearnerSupervisor,
+    finalized_step,
+)
+from distributed_ba3c_tpu.orchestrate.spec import FleetSpec  # noqa: F401
+from distributed_ba3c_tpu.orchestrate.supervisor import (  # noqa: F401
+    FleetSupervisor,
+    default_factory,
+)

@@ -1,0 +1,57 @@
+"""Operator entry point for learner checkpoint-failover:
+
+    python -m distributed_ba3c_tpu.orchestrate \\
+        --logdir runs/x --max_restarts 5 --stall_secs 300 -- \\
+        --trainer tpu_fused_ba3c --env jax:pong --logdir runs/x [...]
+
+Everything after ``--`` goes to train.py verbatim (it must include
+``--logdir`` matching ours and must NOT include ``--load`` — the
+supervisor adds it whenever a finalized checkpoint exists). This is
+scripts/run_with_resume.sh with the failover counted, flight-recorded
+and dumped (docs/orchestration.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.orchestrate.learner import LearnerSupervisor
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if "--" in argv:
+        split = argv.index("--")
+        ours, train_args = argv[:split], argv[split + 1 :]
+    else:
+        ours, train_args = argv, []
+    p = argparse.ArgumentParser(
+        prog="python -m distributed_ba3c_tpu.orchestrate",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--logdir", required=True, help="the run's logdir (same value train.py gets)")
+    p.add_argument("--max_restarts", type=int, default=5)
+    p.add_argument(
+        "--stall_secs", type=float, default=0,
+        help="kill + resume when log.log stops moving for this long "
+        "(0 = crash-only failover, no stall watchdog)",
+    )
+    args = p.parse_args(ours)
+    if not train_args:
+        p.error("no train.py arguments after '--'")
+    telemetry.configure(args.logdir)
+    sup = LearnerSupervisor(
+        args.logdir,
+        train_args,
+        max_restarts=args.max_restarts,
+        stall_secs=args.stall_secs,
+    )
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

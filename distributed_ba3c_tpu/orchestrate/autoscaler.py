@@ -1,0 +1,207 @@
+"""Telemetry-driven autoscaling: backpressure signals → fleet-size moves.
+
+The plane's own physics picks the signals (docs/actor_plane.md): the wire
+is LOCKSTEP, so the train queue's fill fraction says everything about the
+producer/consumer balance —
+
+- the queue sitting near EMPTY with no blocked puts means the learner
+  drains faster than the fleet produces: the learner is starved, add
+  servers;
+- blocked puts ticking (the master waited on a FULL queue) or the queue
+  riding near full means the fleet outruns the learner: backpressure is
+  already pausing actors, so the marginal server adds sync latency and
+  host load but zero throughput — retire servers.
+
+Policy is deliberately bang-bang with hysteresis (watermark deadband +
+``patience`` consecutive ticks + post-decision cooldown): fleet moves cost
+a process spawn and a wire (re)handshake, so the loop must be stable
+against one noisy tick, and every decision must be explainable from one
+snapshot — the decision's inputs ride into the flight recorder with it.
+
+Signals come from :meth:`SimulatorMaster.fleet_snapshot` in-process (the
+usual layout: the supervisor lives in the learner process) or from the
+``--telemetry_port`` ``/json`` endpoint for an out-of-process supervisor —
+both read the SAME telemetry series the scrape endpoint exports.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import Callable, Dict, Optional, Tuple
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.orchestrate.supervisor import FleetSupervisor
+from distributed_ba3c_tpu.utils import logger
+from distributed_ba3c_tpu.utils.concurrency import StoppableThread
+
+
+def master_signals(master) -> Callable[[], Dict[str, float]]:
+    """Signal source over a live master's fleet introspection hook."""
+    return master.fleet_snapshot
+
+
+def http_signals(url: str, timeout_s: float = 2.0) -> Callable[[], Dict[str, float]]:
+    """Signal source over a ``--telemetry_port`` ``/json`` endpoint (for a
+    supervisor running outside the learner process)."""
+    if not url.endswith("/json"):
+        url = url.rstrip("/") + "/json"
+
+    def scrape() -> Dict[str, float]:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            doc = json.loads(r.read().decode())
+        master = doc.get("master", {})
+
+        def val(name: str) -> float:
+            return float(master.get(name, {}).get("value", 0.0))
+
+        return {
+            "clients": val("clients"),
+            "queue_depth": val("train_queue_depth"),
+            "queue_maxsize": val("train_queue_capacity"),
+            "blocked_puts_total": val("queue_blocked_puts_total"),
+            "datapoints_total": val("datapoints_total"),
+        }
+
+    return scrape
+
+
+class AutoscalerPolicy:
+    """The pure decision function (unit-testable without any plane).
+
+    ``decide(signals)`` returns ``(delta, reason)`` with delta in
+    ``{-step, 0, +step}``. Stateful: it tracks consecutive
+    starved/backpressured ticks and the post-decision cooldown.
+    """
+
+    def __init__(
+        self,
+        low_fill: float = 0.25,
+        high_fill: float = 0.75,
+        patience: int = 3,
+        cooldown_ticks: int = 5,
+        step: int = 1,
+    ):
+        if not 0 <= low_fill < high_fill <= 1:
+            raise ValueError(
+                f"need 0 <= low_fill < high_fill <= 1, got "
+                f"{low_fill}/{high_fill}"
+            )
+        self.low_fill = low_fill
+        self.high_fill = high_fill
+        self.patience = max(1, patience)
+        self.cooldown_ticks = max(0, cooldown_ticks)
+        self.step = max(1, step)
+        self._starved = 0
+        self._pressured = 0
+        self._cooldown = 0
+        self._last_blocked = None  # None until the first tick baselines it
+
+    def decide(self, s: Dict[str, float]) -> Tuple[int, str]:
+        depth = float(s.get("queue_depth", 0))
+        cap = float(s.get("queue_maxsize", 0))
+        # no known bound (unbounded custom queue, or a scrape target that
+        # predates the train_queue_capacity gauge) -> the fill fraction is
+        # UNKNOWN, not zero: a 0.0 sentinel would read as permanently
+        # starved and ratchet the fleet to fleet_max on no signal at all.
+        # The blocked-put delta still works capacity-free, so scale-DOWN
+        # stays available.
+        fill = depth / cap if cap > 0 else None
+        blocked = float(s.get("blocked_puts_total", 0))
+        if self._last_blocked is None:
+            # first tick baselines the counter — a delta against 0 would
+            # read the whole pre-attach history as fresh backpressure
+            self._last_blocked = blocked
+            return 0, ""
+        blocked_delta = blocked - self._last_blocked
+        self._last_blocked = blocked
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return 0, ""
+        if blocked_delta > 0 or (fill is not None and fill >= self.high_fill):
+            self._pressured += 1
+            self._starved = 0
+        elif fill is not None and fill <= self.low_fill:
+            self._starved += 1
+            self._pressured = 0
+        else:
+            self._starved = self._pressured = 0
+        if self._pressured >= self.patience:
+            self._pressured = self._starved = 0
+            self._cooldown = self.cooldown_ticks
+            return -self.step, (
+                f"backpressure: queue fill "
+                f"{'unknown' if fill is None else format(fill, '.2f')}, "
+                f"+{blocked_delta:.0f} blocked puts — the learner is the "
+                "bottleneck, extra servers only add latency"
+            )
+        if self._starved >= self.patience:
+            self._pressured = self._starved = 0
+            self._cooldown = self.cooldown_ticks
+            return self.step, (
+                f"starved: queue fill {fill:.2f} with no blocked puts — "
+                "the learner outruns the fleet"
+            )
+        return 0, ""
+
+
+class Autoscaler(StoppableThread):
+    """The policy loop: scrape → decide → ``supervisor.scale_by``.
+
+    Every decision (and its input snapshot) is flight-recorded and the
+    tick/decision counts ride ``tele/orchestrator/*`` — a scale event in a
+    postmortem always comes with the signals that caused it.
+    """
+
+    def __init__(
+        self,
+        supervisor: FleetSupervisor,
+        signals: Callable[[], Dict[str, float]],
+        policy: Optional[AutoscalerPolicy] = None,
+        interval_s: float = 2.0,
+    ):
+        super().__init__(daemon=True, name="Autoscaler")
+        self.supervisor = supervisor
+        self._signals = signals
+        self.policy = policy or AutoscalerPolicy()
+        self.interval_s = interval_s
+        self._flight = telemetry.flight_recorder()
+        tele = telemetry.registry("orchestrator")
+        self._c_ticks = tele.counter("autoscale_ticks_total")
+        self._c_decisions = tele.counter("autoscale_decisions_total")
+        self._c_errors = tele.counter("autoscale_signal_errors_total")
+
+    def run(self) -> None:
+        while not self.stopped():
+            self.tick()
+            self._stop_evt.wait(self.interval_s)
+
+    def tick(self) -> None:
+        """One scrape→decide→act step (public so tests and the chaos
+        bench can drive the loop deterministically)."""
+        self._c_ticks.inc()
+        try:
+            s = self._signals()
+        except Exception as e:
+            # a torn-down master / unreachable endpoint must not kill the
+            # loop — skip the tick, count it, keep watching
+            self._c_errors.inc()
+            logger.warn("autoscaler signal scrape failed: %s", e)
+            return
+        delta, reason = self.policy.decide(s)
+        if delta == 0:
+            return
+        old = self.supervisor.target
+        new = self.supervisor.scale_by(delta, reason=reason)
+        self._c_decisions.inc()
+        self._flight.record(
+            "scale_decision",
+            delta=delta,
+            frm=old,
+            to=new,
+            reason=reason[:200],
+            queue_depth=s.get("queue_depth"),
+            queue_maxsize=s.get("queue_maxsize"),
+            blocked_puts_total=s.get("blocked_puts_total"),
+        )

@@ -1,0 +1,84 @@
+"""Chaos injection: randomly SIGKILL supervised env servers, on purpose.
+
+The acceptance story for the whole orchestration stack
+(docs/orchestration.md, scripts/chaos_bench.py): with servers being
+SIGKILLed at random mid-run, the plane must hold >=90% of its no-chaos
+steady-state throughput — the master prunes/incarnation-resets, the
+supervisor respawns with backoff, and the telemetry plane shows every
+event. The monkey is deliberately dumb: pick a live slot, SIGKILL it (no
+goodbye on the wire — exactly an OOM kill), wait, repeat. Seeded RNG so a
+failing chaos run replays its exact kill sequence.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.orchestrate.supervisor import FleetSupervisor
+from distributed_ba3c_tpu.utils import logger
+from distributed_ba3c_tpu.utils.concurrency import StoppableThread
+
+
+class ChaosMonkey(StoppableThread):
+    """SIGKILL a random live server every ``interval_s`` (+- ``jitter_s``),
+    up to ``max_kills`` (None = until stopped)."""
+
+    def __init__(
+        self,
+        supervisor: FleetSupervisor,
+        interval_s: float = 3.0,
+        jitter_s: float = 0.5,
+        max_kills: Optional[int] = None,
+        seed: int = 0,
+        initial_delay_s: Optional[float] = None,
+    ):
+        super().__init__(daemon=True, name="ChaosMonkey")
+        self.supervisor = supervisor
+        self.interval_s = interval_s
+        self.jitter_s = jitter_s
+        self.max_kills = max_kills
+        self.kills = 0
+        self._rng = random.Random(seed)
+        self._initial_delay_s = (
+            interval_s if initial_delay_s is None else initial_delay_s
+        )
+        self._flight = telemetry.flight_recorder()
+        self._c_kills = telemetry.registry("orchestrator").counter(
+            "chaos_kills_total"
+        )
+
+    def run(self) -> None:
+        self._stop_evt.wait(self._initial_delay_s)
+        while not self.stopped():
+            if self.max_kills is not None and self.kills >= self.max_kills:
+                return
+            self.kill_one()
+            self._stop_evt.wait(
+                max(0.05, self.interval_s + self._rng.uniform(
+                    -self.jitter_s, self.jitter_s
+                ))
+            )
+
+    def kill_one(self) -> Optional[int]:
+        """SIGKILL one random live slot; returns its index (None if the
+        fleet had no live victim this instant)."""
+        live = self.supervisor.live_slots()
+        if not live:
+            return None
+        idx, proc = self._rng.choice(live)
+        if not self.supervisor.sigkill_slot(idx):
+            return None
+        self.kills += 1
+        self._c_kills.inc()
+        self._flight.record(
+            "chaos_kill", slot=idx, pid=getattr(proc, "pid", None),
+            kill_no=self.kills,
+        )
+        logger.warn(
+            "chaos: SIGKILLed env server slot %d (kill %d%s)", idx,
+            self.kills,
+            f"/{self.max_kills}" if self.max_kills is not None else "",
+        )
+        return idx

@@ -1,0 +1,247 @@
+"""Learner checkpoint-failover: ``run_with_resume.sh`` promoted into the
+supervisor, with the resume counted and postmortem-dumped.
+
+The shell launcher (scripts/run_with_resume.sh) already had the right
+semantics — relaunch a dead trainer with ``--load`` whenever a FINALIZED
+checkpoint exists, never resume from an empty dir, give startup extra
+stall grace — but it was invisible to the telemetry plane: a failover left
+no counter, no flight event, no dump. This class is the same loop as a
+supervised component: a SIGKILLed learner resumes from the last finalized
+checkpoint without operator action, and the resume is accounted as
+``tele/orchestrator/learner_*`` series plus a ``learner_failover`` flight
+event (docs/orchestration.md).
+
+Entry point: ``python -m distributed_ba3c_tpu.orchestrate`` (the shell
+script stays for bare-metal compat and points here).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.utils import logger
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def finalized_step(ckpt_dir: str) -> Optional[int]:
+    """The last FINALIZED checkpoint step, or None.
+
+    The resume gate is checkpoint.json's non-null ``latest`` — written
+    only after the save's wait_until_finished — NOT the directory's
+    existence: CheckpointManager creates the dir at startup, so a crash
+    before the first save must not make every retry ``--load`` an empty
+    dir and burn the restart budget on a run that never trained (same
+    gate as run_with_resume.sh / launch_multihost.sh).
+    """
+    meta = os.path.join(ckpt_dir, "checkpoint.json")
+    try:
+        with open(meta) as fh:
+            latest = json.load(fh).get("latest")
+        return int(latest) if latest is not None else None
+    except (OSError, ValueError, TypeError):
+        return None
+
+
+class LearnerSupervisor:
+    """Run the learner as a supervised child; resume it from the last
+    finalized checkpoint when it dies.
+
+    ``train_args`` are train.py's arguments and must include ``--logdir
+    <logdir>`` but NOT ``--load`` — the supervisor adds ``--load
+    <logdir>/checkpoints`` whenever a finalized checkpoint exists, so
+    re-running the same command over a prior run's logdir RESUMES it.
+
+    ``stall_secs > 0`` adds the shell launcher's stall watchdog: no
+    ``log.log`` mtime progress for that long kills the process GROUP
+    (the trainer owns its session via ``start_new_session``) and lets the
+    resume path take over. Startup gets ``startup_grace_s`` extra until
+    the attempt's first log write (XLA compile + pool claim).
+    """
+
+    def __init__(
+        self,
+        logdir: str,
+        train_args: List[str],
+        max_restarts: int = 5,
+        stall_secs: float = 0.0,
+        startup_grace_s: float = 600.0,
+        train_py: Optional[str] = None,
+        python: Optional[str] = None,
+        poll_s: float = 1.0,
+    ):
+        self.logdir = logdir
+        self.ckpt_dir = os.path.join(logdir, "checkpoints")
+        self.train_args = list(train_args)
+        if "--load" in self.train_args:
+            raise ValueError(
+                "--load belongs to the supervisor: it is added automatically "
+                "whenever a finalized checkpoint exists in the run's logdir"
+            )
+        # the stall watchdog stats <logdir>/log.log and the resume gate
+        # reads <logdir>/checkpoints — a train_args --logdir pointing
+        # elsewhere would make the supervisor kill a healthy learner on
+        # phantom stalls and resume from a directory the child never
+        # writes. Catch the typo at construction, like --load above.
+        if "--logdir" in self.train_args:
+            child_logdir = self.train_args[
+                self.train_args.index("--logdir") + 1
+            ]
+            if os.path.abspath(child_logdir) != os.path.abspath(logdir):
+                raise ValueError(
+                    f"train args --logdir {child_logdir!r} does not match "
+                    f"the supervisor's logdir {logdir!r} — the watchdog "
+                    "and the resume gate both read the supervisor's path"
+                )
+        else:
+            raise ValueError(
+                "train args must include --logdir (matching the "
+                "supervisor's) — train.py's default logdir would desync "
+                "the stall watchdog and the resume gate"
+            )
+        self.max_restarts = max_restarts
+        self.stall_secs = stall_secs
+        self.startup_grace_s = startup_grace_s
+        self.train_py = train_py or os.path.join(_REPO_ROOT, "train.py")
+        self.python = python or sys.executable
+        self.poll_s = poll_s
+        self.attempt = 0
+        self.child_pid: Optional[int] = None  # the live attempt's pid
+        self._flight = telemetry.flight_recorder()
+        tele = telemetry.registry("orchestrator")
+        self._c_restarts = tele.counter("learner_restarts_total")
+        self._c_resumes = tele.counter("learner_resumes_total")
+        self._g_attempt = tele.gauge("learner_attempt")
+
+    def run(self) -> int:
+        """Blocking supervision loop; returns the final exit code (0 =
+        the learner finished cleanly, possibly across several resumes)."""
+        while True:
+            rc = self._run_attempt()
+            if rc == 0:
+                logger.info(
+                    "learner finished cleanly after %d restart(s)",
+                    self.attempt,
+                )
+                return 0
+            self.attempt += 1
+            if self.attempt > self.max_restarts:
+                logger.error(
+                    "learner giving up after %d restarts (rc=%s)",
+                    self.max_restarts, rc,
+                )
+                self._flight.record(
+                    "learner_giveup", rc=rc, attempts=self.attempt
+                )
+                self._flight.dump("learner restart budget exhausted")
+                return rc
+            step = finalized_step(self.ckpt_dir)
+            self._c_restarts.inc()
+            if step is not None:
+                self._c_resumes.inc()
+            # the failover IS the postmortem moment: the next operator to
+            # look must find on disk that the learner died with rc=<x> and
+            # resumed from step <y> — without having watched the console
+            self._flight.record(
+                "learner_failover",
+                rc=rc,
+                attempt=self.attempt,
+                resume_step=step,
+            )
+            self._flight.dump("learner failover")
+            logger.warn(
+                "learner died (rc=%s) — attempt %d/%d %s",
+                rc, self.attempt, self.max_restarts,
+                f"resuming from finalized step {step}"
+                if step is not None
+                else "restarting from scratch (no finalized checkpoint)",
+            )
+
+    def _run_attempt(self) -> int:
+        args = list(self.train_args)
+        if finalized_step(self.ckpt_dir) is not None:
+            args += ["--load", self.ckpt_dir]
+        self._g_attempt.set(self.attempt)
+        logger.info(
+            "[learner supervisor] attempt %d: %s %s %s",
+            self.attempt, self.python, self.train_py, " ".join(args),
+        )
+        # own session/process group: a stall kill must reap the trainer
+        # AND its spawned children (env servers, simulators) without
+        # touching unrelated processes
+        child = subprocess.Popen(
+            [self.python, self.train_py] + args, start_new_session=True
+        )
+        self.child_pid = child.pid
+        start = time.monotonic()
+        # wall clock on purpose: stall progress is the log FILE's st_mtime,
+        # which only compares against wall time
+        start_wall = time.time()  # ba3clint: disable=A4
+        log_path = os.path.join(self.logdir, "log.log")
+        try:
+            while True:
+                rc = child.poll()
+                if rc is not None:
+                    return rc
+                if self.stall_secs > 0 and self._stalled(
+                    log_path, start_wall
+                ):
+                    age = time.monotonic() - start
+                    logger.warn(
+                        "[learner supervisor] stall after %.0fs — killing "
+                        "group %d", age, child.pid,
+                    )
+                    self._flight.record(
+                        "learner_stall_kill", pid=child.pid,
+                        age_s=round(age, 1),
+                    )
+                    self._kill_group(child)
+                    return child.wait() or 1
+                time.sleep(self.poll_s)
+        finally:
+            self.child_pid = None
+            if child.poll() is None:
+                self._kill_group(child)
+                child.wait()
+
+    def _stalled(self, log_path: str, attempt_start_wall: float) -> bool:
+        """The shell watchdog's rule: progress = the run log's mtime;
+        measured against max(attempt start, log mtime) so a stale log from
+        a PREVIOUS attempt cannot kill this one, and until this attempt's
+        first write the threshold gets the startup grace."""
+        last = attempt_start_wall
+        thresh = self.stall_secs + self.startup_grace_s
+        try:
+            m = os.stat(log_path).st_mtime
+            if m > last:
+                last = m
+                thresh = self.stall_secs
+        except OSError:
+            pass
+        # wall arithmetic is forced by st_mtime above; an NTP step can at
+        # worst delay or hasten ONE stall kill, never corrupt training
+        return time.time() - last > thresh  # ba3clint: disable=A4
+
+    @staticmethod
+    def _kill_group(child: subprocess.Popen) -> None:
+        try:
+            os.killpg(child.pid, signal.SIGTERM)
+        except (OSError, ProcessLookupError):
+            pass
+        deadline = time.monotonic() + 5.0
+        while child.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.1)
+        if child.poll() is None:
+            try:
+                os.killpg(child.pid, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                pass

@@ -1,0 +1,111 @@
+"""Declarative fleet spec: what the supervisor owns and how it may act.
+
+One frozen dataclass describes a fleet end-to-end — the env-server shape
+(game, wire, envs per server, pipes) plus the ORCHESTRATION policy (size
+bounds, respawn backoff, restart budget). The supervisor
+(orchestrate/supervisor.py) is pure mechanism; every number it acts on
+lives here, so a fleet's behavior is reviewable as data and a spec file
+checked into a run's logdir reproduces its orchestration exactly.
+
+The reference paper's 64-node cluster had no equivalent: fleet shape was
+an ssh fan-out argument and policy was an operator reading logs
+(SURVEY.md §2.8 #29). docs/orchestration.md documents every knob.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSpec:
+    """A supervised env-server fleet, sized in SERVER PROCESSES.
+
+    ``fleet_size`` is the launch target; the autoscaler (when attached)
+    moves the target inside ``[fleet_min, fleet_max]``. Each server hosts
+    ``envs_per_server`` lockstep envs, so total env count scales in
+    server-sized steps — the granularity the wire already batches at.
+    """
+
+    # -- env-server shape (mirrors CppEnvServerProcess's surface) ---------
+    pipe_c2s: str = ""
+    pipe_s2c: str = ""
+    game: str = "pong"
+    envs_per_server: int = 16
+    frame_history: int = 4
+    wire: str = "block"
+    shm_ring_cap: Optional[int] = None
+    #: first server index — distinct across actor hosts so ZMQ identities
+    #: (cppsim-<idx>...) never collide (scripts/launch_env_fleet.py)
+    base_idx: int = 0
+
+    # -- fleet sizing ------------------------------------------------------
+    fleet_size: int = 4
+    fleet_min: int = 1
+    fleet_max: int = 8
+
+    # -- respawn policy ----------------------------------------------------
+    #: first-respawn delay; doubles per consecutive failure of the slot
+    backoff_base_s: float = 0.5
+    backoff_max_s: float = 30.0
+    #: a slot alive this long resets its consecutive-failure streak
+    stable_after_s: float = 30.0
+    #: circuit breaker: more than this many respawns inside
+    #: ``budget_window_s`` opens the circuit (respawns pause fleet-wide
+    #: until the window drains to half the budget) — a crash LOOP must
+    #: degrade to a visible incident, not an infinite fork storm
+    restart_budget: int = 16
+    budget_window_s: float = 300.0
+
+    def __post_init__(self):
+        if self.wire not in ("block-shm", "block", "per-env"):
+            raise ValueError(f"unknown wire {self.wire!r}")
+        if self.envs_per_server < 1:
+            raise ValueError("envs_per_server must be >= 1")
+        if not (1 <= self.fleet_min <= self.fleet_max):
+            raise ValueError(
+                f"need 1 <= fleet_min <= fleet_max, got "
+                f"[{self.fleet_min}, {self.fleet_max}]"
+            )
+        if not (self.fleet_min <= self.fleet_size <= self.fleet_max):
+            raise ValueError(
+                f"fleet_size {self.fleet_size} outside "
+                f"[{self.fleet_min}, {self.fleet_max}]"
+            )
+        if self.backoff_base_s < 0 or self.backoff_max_s < self.backoff_base_s:
+            raise ValueError(
+                f"need 0 <= backoff_base_s <= backoff_max_s, got "
+                f"{self.backoff_base_s}/{self.backoff_max_s}"
+            )
+        if self.restart_budget < 0:
+            raise ValueError("restart_budget must be >= 0")
+
+    def backoff_s(self, consecutive_failures: int) -> float:
+        """Respawn delay after the N-th consecutive failure of one slot
+        (N >= 1): ``base * 2^(N-1)`` capped at ``backoff_max_s``."""
+        n = max(1, int(consecutive_failures))
+        return min(self.backoff_max_s, self.backoff_base_s * (2 ** (n - 1)))
+
+    # -- (de)serialization -------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FleetSpec":
+        doc = json.loads(text)
+        if not isinstance(doc, dict):
+            raise ValueError("fleet spec must be a JSON object")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(doc) - known)
+        if unknown:
+            # a typoed knob must fail the launch, not silently run with
+            # the default it was trying to override
+            raise ValueError(f"unknown fleet spec fields: {unknown}")
+        return cls(**doc)
+
+    @classmethod
+    def load(cls, path: str) -> "FleetSpec":
+        with open(path) as fh:
+            return cls.from_json(fh.read())

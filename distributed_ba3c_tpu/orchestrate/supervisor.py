@@ -1,0 +1,508 @@
+"""FleetSupervisor: the env-server fleet's lifecycle, owned end-to-end.
+
+The PR-4 actor plane *survives* failure (the master prunes silent clients,
+resets incarnations, drops ring-refusing speakers) and the PR-5 telemetry
+plane *measures* it (prune counters, flight-recorder postmortems, fleet
+piggyback). What neither does is ACT: a SIGKILLed env server stayed dead
+until an operator noticed, and fleet size was fixed at launch
+(scripts/launch_env_fleet.py walked away after spawning). The supervisor
+closes that loop:
+
+- **spawn** every server slot from a declarative :class:`FleetSpec`
+  (orchestrate/spec.py), via a pluggable factory so C++ block servers,
+  python SimulatorProcesses and test fakes all ride the same lifecycle;
+- **detect** death two ways — the process table (a crashed child) and the
+  MASTER'S telemetry account (a ``prune`` flight-recorder event for a
+  slot whose process is still alive means the server is wedged: the
+  master gave up on it after ``actor_timeout`` of silence). The
+  supervisor keeps no duplicate heartbeat plane of its own;
+- **respawn** with per-slot exponential backoff and a fleet-wide
+  restart-budget circuit breaker (a crash loop must degrade into a
+  visible incident, not a fork storm), reclaiming stale /dev/shm rings
+  before each block-shm spawn;
+- **scale** between ``fleet_min``/``fleet_max`` on demand
+  (:meth:`scale_to`, driven by orchestrate/autoscaler.py), retiring the
+  highest slots first;
+- **account** everything as ``tele/orchestrator/*`` series and
+  flight-recorder events, so every scale/respawn decision is visible on
+  the scrape endpoint and in postmortems (docs/orchestration.md).
+
+The supervisor satisfies the StartProcOrThread protocol
+(start/stop/join/close), so cli.py appends it to the startables list in
+place of a bare process list.
+"""
+
+from __future__ import annotations
+
+import collections
+import multiprocessing as mp
+import os
+import signal
+import threading
+import time
+import weakref
+from typing import Callable, Dict, List, Optional, Tuple
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.orchestrate.spec import FleetSpec
+from distributed_ba3c_tpu.utils import logger
+from distributed_ba3c_tpu.utils.concurrency import (
+    StoppableThread,
+    ensure_proc_terminate,
+    start_proc_mask_signal,
+)
+
+#: ident-repr delimiters that may legally follow a slot's ident prefix
+#: (``cppsim-3*block``, ``cppsim-3-7``, ``simulator-2``) — a prefix match
+#: NOT followed by one of these is a longer index (cppsim-30 vs cppsim-3)
+_IDENT_DELIMS = ("'", '"', "*", "-")
+
+
+class _Slot:
+    """One server slot: the process currently (or about to be) filling it
+    plus its failure bookkeeping. Slot index — not pid — is the stable
+    identity: the wire ident and the shm ring name derive from it, which
+    is what makes a respawn land as an incarnation reset instead of a
+    brand-new client."""
+
+    __slots__ = (
+        "idx", "proc", "started_t", "failures", "next_spawn_t",
+        "ever_started",
+    )
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.proc = None
+        self.started_t = 0.0
+        self.failures = 0
+        self.next_spawn_t = 0.0  # monotonic; 0 = spawn at next tick
+        self.ever_started = False
+
+
+def default_factory(
+    spec: FleetSpec, total_envs: Optional[int] = None
+) -> Callable[[int], object]:
+    """Factory building the spec's C++ env servers (one per slot).
+
+    ``total_envs`` keeps CLI compat with env-count-shaped configs
+    (``--n_envs``/``--simulator_procs`` need not divide
+    ``envs_per_server``): the slot covering the remainder hosts fewer
+    envs; slots GROWN past the initial fleet host the full block.
+    """
+
+    def build(slot_idx: int):
+        from distributed_ba3c_tpu.envs import native
+
+        n = spec.envs_per_server
+        if total_envs is not None:
+            remaining = total_envs - slot_idx * spec.envs_per_server
+            if 0 < remaining < n:
+                n = remaining
+        return native.CppEnvServerProcess(
+            spec.base_idx + slot_idx,
+            spec.pipe_c2s,
+            spec.pipe_s2c,
+            game=spec.game,
+            n_envs=n,
+            frame_history=spec.frame_history,
+            wire=spec.wire,
+            shm_ring_cap=spec.shm_ring_cap,
+        )
+
+    return build
+
+
+class FleetSupervisor(StoppableThread):
+    """Supervise one fleet of env-server processes per the spec.
+
+    ``factory(slot_idx)`` returns an UNSTARTED process-like object
+    (``start/is_alive/terminate/kill/join``, optional ``pid``/``exitcode``)
+    — a fresh object per call, since a multiprocessing.Process cannot be
+    restarted. ``ident_prefix(slot_idx)`` names the slot's wire-identity
+    prefix (default: the C++ servers' ``cppsim-<base+idx>``), used to map
+    the master's prune events back to slots.
+    """
+
+    def __init__(
+        self,
+        spec: FleetSpec,
+        factory: Optional[Callable[[int], object]] = None,
+        ident_prefix: Optional[Callable[[int], str]] = None,
+        poll_interval_s: float = 0.25,
+    ):
+        super().__init__(daemon=True, name="FleetSupervisor")
+        self.spec = spec
+        self._factory = factory or default_factory(spec)
+        self._ident_prefix = ident_prefix or (
+            lambda i: f"cppsim-{spec.base_idx + i}"
+        )
+        self.poll_interval_s = poll_interval_s
+        # one lock over slot-table structure: ticks, scale ops and chaos
+        # kills come from different threads, none of them hot
+        self._lock = threading.RLock()
+        self._slots: Dict[int, _Slot] = {}
+        # retired-but-not-yet-reaped processes: (slot_idx, proc, kill_at).
+        # scale_to only TERMINATES; the tick reaps, escalating to SIGKILL
+        # after a grace — a slow-exiting server must not linger as a
+        # zombie (or still hold its slot's wire identity when the slot is
+        # re-grown; ROUTER_HANDOVER would flip replies between two live
+        # servers)
+        self._retired: List[tuple] = []
+        self._target = spec.fleet_size
+        self._respawn_times: collections.deque = collections.deque()
+        self._circuit_open = spec.restart_budget == 0
+        self._fleet_started = False
+
+        self._flight = telemetry.flight_recorder()
+        # wedge detection reads the master's prune stream from the flight
+        # ring; only events recorded after OUR start matter
+        self._events_after = time.monotonic()
+
+        tele = telemetry.registry("orchestrator")
+        self._c_spawns = tele.counter("server_spawns_total")
+        self._c_respawns = tele.counter("server_respawns_total")
+        self._c_deaths = tele.counter("server_deaths_total")
+        self._c_wedged = tele.counter("wedged_kills_total")
+        self._c_scale_up = tele.counter("scale_up_total")
+        self._c_scale_down = tele.counter("scale_down_total")
+        self._c_circuit = tele.counter("circuit_trips_total")
+        self._c_rings = tele.counter("rings_reclaimed_total")
+        # the scaled-down-on-purpose vs lost-half-the-fleet distinction
+        # lives in this gauge PAIR: target is what the orchestrator wants,
+        # live is what the process table shows. target == live == small is
+        # a deliberate scale-down; target >> live is an incident.
+        ref = weakref.ref(self)
+        tele.gauge(
+            "fleet_target_size", fn=lambda: s._target if (s := ref()) else 0
+        )
+        tele.gauge(
+            "fleet_live_size",
+            fn=lambda: s.live_count() if (s := ref()) else 0,
+        )
+        tele.gauge(
+            "circuit_open",
+            fn=lambda: int(s._circuit_open) if (s := ref()) else 0,
+        )
+
+    # -- lifecycle (StartProcOrThread protocol) ----------------------------
+    def start(self) -> None:
+        """Spawn the initial fleet, then start the supervision loop."""
+        with self._lock:
+            if not self._fleet_started:
+                self._fleet_started = True
+                for i in range(self._target):
+                    self._slots[i] = _Slot(i)
+                    self._spawn(self._slots[i])
+        super().start()
+        logger.info(
+            "fleet supervisor up: %d/%d servers (bounds [%d, %d], wire %s)",
+            self.live_count(), self._target,
+            self.spec.fleet_min, self.spec.fleet_max, self.spec.wire,
+        )
+
+    def run(self) -> None:
+        while not self.stopped():
+            try:
+                self._tick()
+            except Exception:
+                # the supervision loop is the component that must not die
+                # of one bad tick — log and keep supervising
+                logger.exception("fleet supervisor tick failed")
+            self._stop_evt.wait(self.poll_interval_s)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self.is_alive():
+            super().join(timeout)
+
+    def close(self) -> None:
+        """Terminate and reap every child (idempotent) — including
+        scale-down retirees the tick has not reaped yet."""
+        self.stop()
+        with self._lock:
+            procs = [s.proc for s in self._slots.values() if s.proc is not None]
+            procs += [p for _, p, _ in self._retired]
+            self._retired = []
+        for p in procs:
+            try:
+                if p.is_alive():
+                    p.terminate()
+            except Exception:
+                pass
+        for p in procs:
+            try:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.kill()
+                    p.join(timeout=5)
+            except Exception:
+                pass
+
+    # -- introspection -----------------------------------------------------
+    def live_count(self) -> int:
+        with self._lock:
+            return sum(
+                1
+                for s in self._slots.values()
+                if s.proc is not None and s.proc.is_alive()
+            )
+
+    @property
+    def target(self) -> int:
+        return self._target
+
+    @property
+    def circuit_open(self) -> bool:
+        return self._circuit_open
+
+    def live_slots(self) -> List[Tuple[int, object]]:
+        """``[(slot_idx, proc)]`` for currently-alive slots (chaos
+        injection picks its victims here)."""
+        with self._lock:
+            return [
+                (s.idx, s.proc)
+                for s in sorted(self._slots.values(), key=lambda x: x.idx)
+                if s.proc is not None and s.proc.is_alive()
+            ]
+
+    def sigkill_slot(self, idx: int) -> bool:
+        """SIGKILL a slot's process (chaos harness / tests): no goodbye on
+        the wire, exactly like an OOM kill. Returns False if not alive."""
+        with self._lock:
+            slot = self._slots.get(idx)
+            proc = slot.proc if slot is not None else None
+        if proc is None or not proc.is_alive():
+            return False
+        pid = getattr(proc, "pid", None)
+        if pid:
+            os.kill(pid, signal.SIGKILL)
+        else:  # duck-typed test fakes have no real pid
+            proc.kill()
+        return True
+
+    # -- scaling -----------------------------------------------------------
+    def scale_by(self, delta: int, reason: str = "") -> int:
+        return self.scale_to(self._target + delta, reason)
+
+    def scale_to(self, n: int, reason: str = "") -> int:
+        """Move the fleet target to ``n`` (clamped to the spec bounds);
+        returns the new target. Growth adds fresh slots (spawned by the
+        next tick); shrink retires the highest slots immediately. Every
+        ACTUAL change is counted and flight-recorded — scale decisions
+        must be postmortem-visible."""
+        spec = self.spec
+        n = max(spec.fleet_min, min(spec.fleet_max, int(n)))
+        with self._lock:
+            old = self._target
+            if n == old:
+                return old
+            self._target = n
+            if n > old:
+                for i in range(old, n):
+                    # slot indices are dense 0..target-1: a retired slot's
+                    # index (and thus wire ident + ring name) is reused by
+                    # the next growth, keeping ring files bounded by
+                    # fleet_max ever existing
+                    if i not in self._slots:
+                        self._slots[i] = _Slot(i)
+                self._c_scale_up.inc()
+                self._flight.record(
+                    "scale_up", frm=old, to=n, reason=reason[:200]
+                )
+                logger.info("fleet scale up %d -> %d (%s)", old, n, reason)
+            else:
+                retired = [i for i in sorted(self._slots) if i >= n]
+                for i in retired:
+                    slot = self._slots.pop(i)
+                    if slot.proc is not None:
+                        try:
+                            slot.proc.terminate()
+                        except Exception:
+                            pass
+                        # the tick reaps (SIGKILL past the grace) — see
+                        # _reap_retired
+                        self._retired.append(
+                            (i, slot.proc, time.monotonic() + 5.0)
+                        )
+                self._c_scale_down.inc()
+                self._flight.record(
+                    "scale_down", frm=old, to=n, retired=retired,
+                    reason=reason[:200],
+                )
+                logger.info("fleet scale down %d -> %d (%s)", old, n, reason)
+            return n
+
+    # -- the supervision loop ----------------------------------------------
+    def _tick(self) -> None:
+        now = time.monotonic()
+        self._kill_wedged(now)
+        with self._lock:
+            self._reap_retired(now)
+            self._reap_deaths(now)
+            self._update_circuit(now)
+            if not self._circuit_open:
+                retired_idxs = {idx for idx, _, _ in self._retired}
+                for slot in self._slots.values():
+                    if (
+                        slot.proc is None
+                        and now >= slot.next_spawn_t
+                        # a re-grown slot waits for its retiree to be
+                        # fully reaped (identity exclusivity, above)
+                        and slot.idx not in retired_idxs
+                    ):
+                        self._spawn(slot)
+
+    def _reap_retired(self, now: float) -> None:
+        """Finish off scale-down retirees: join the exited, SIGKILL the
+        ones that outlived the terminate grace. A retiree must be fully
+        dead before its slot index can be re-grown — its wire identity is
+        the slot's, and two live claimants would flip-flop the ROUTER's
+        reply routing (handover takes the newest connect)."""
+        still = []
+        for idx, p, kill_at in self._retired:
+            try:
+                if not p.is_alive():
+                    p.join(timeout=0)
+                    continue
+                if now >= kill_at:
+                    p.kill()
+            except Exception:
+                pass
+            still.append((idx, p, kill_at))
+        self._retired = still
+
+    def _reap_deaths(self, now: float) -> None:
+        for slot in self._slots.values():
+            p = slot.proc
+            if p is None or p.is_alive():
+                continue
+            try:
+                p.join(timeout=0)
+            except Exception:
+                pass
+            uptime = now - slot.started_t
+            # a slot that ran stably before dying starts a fresh failure
+            # streak — backoff punishes crash LOOPS, not one-off kills
+            slot.failures = (
+                1 if uptime >= self.spec.stable_after_s else slot.failures + 1
+            )
+            delay = self.spec.backoff_s(slot.failures)
+            slot.next_spawn_t = now + delay
+            slot.proc = None
+            self._c_deaths.inc()
+            self._flight.record(
+                "server_death",
+                slot=slot.idx,
+                exitcode=getattr(p, "exitcode", None),
+                uptime_s=round(uptime, 3),
+                failures=slot.failures,
+                respawn_in_s=round(delay, 3),
+            )
+            logger.warn(
+                "env server slot %d died (exit %s, up %.1fs) — respawn in "
+                "%.2fs", slot.idx, getattr(p, "exitcode", None), uptime, delay,
+            )
+
+    def _kill_wedged(self, now: float) -> None:
+        """Act on the MASTER'S liveness verdicts: a prune event for a slot
+        whose process is still alive means the server is wedged (silent on
+        the wire past ``actor_timeout``) — kill it so the normal respawn
+        path takes over. The supervisor never second-guesses the master's
+        account with heartbeats of its own."""
+        events = self._flight.events_since(self._events_after, kind="prune")
+        if not events:
+            return
+        self._events_after = max(ev[0] for ev in events)
+        for t, _, fields in events:
+            ident_repr = str(fields.get("ident", ""))
+            with self._lock:
+                idx = self._slot_for_ident(ident_repr)
+                slot = self._slots.get(idx) if idx is not None else None
+                proc = slot.proc if slot is not None else None
+                # only a prune issued AGAINST the current incarnation is a
+                # wedge verdict; one recorded before this process started
+                # refers to its predecessor
+                stale = slot is None or t <= slot.started_t
+            if proc is None or stale or not proc.is_alive():
+                continue
+            self._c_wedged.inc()
+            self._flight.record(
+                "wedged_kill", slot=slot.idx, ident=ident_repr[:120]
+            )
+            logger.warn(
+                "master pruned slot %d (%s) but its process is alive — "
+                "killing the wedged server", slot.idx, ident_repr,
+            )
+            try:
+                proc.kill()
+            except Exception:
+                pass
+
+    def _slot_for_ident(self, ident_repr: str) -> Optional[int]:
+        for idx in self._slots:
+            p = self._ident_prefix(idx)
+            i = ident_repr.find(p)
+            while i != -1:
+                nxt = ident_repr[i + len(p) : i + len(p) + 1]
+                if nxt == "" or nxt in _IDENT_DELIMS:
+                    return idx
+                i = ident_repr.find(p, i + 1)
+        return None
+
+    def _update_circuit(self, now: float) -> None:
+        if self.spec.restart_budget == 0:
+            return  # permanently open: respawns disabled by spec
+        window = self.spec.budget_window_s
+        while self._respawn_times and now - self._respawn_times[0] > window:
+            self._respawn_times.popleft()
+        n = len(self._respawn_times)
+        if not self._circuit_open and n >= self.spec.restart_budget:
+            self._circuit_open = True
+            self._c_circuit.inc()
+            self._flight.record(
+                "circuit_open", respawns_in_window=n, window_s=window
+            )
+            logger.error(
+                "respawn circuit OPEN: %d respawns inside %.0fs (budget "
+                "%d) — fleet respawns paused", n, window,
+                self.spec.restart_budget,
+            )
+            # a tripped breaker IS the incident: evidence goes to disk now
+            self._flight.dump("respawn circuit open")
+        elif self._circuit_open and n <= self.spec.restart_budget // 2:
+            self._circuit_open = False
+            self._flight.record("circuit_close", respawns_in_window=n)
+            logger.info(
+                "respawn circuit closed (%d respawns left in window)", n
+            )
+
+    def _spawn(self, slot: _Slot) -> None:
+        if self.spec.wire == "block-shm":
+            # the dead incarnation's ring file (possibly another geometry
+            # from an older spec) must be gone before the new server
+            # creates — reclaim is safe exactly now, with the slot empty
+            from distributed_ba3c_tpu.utils import shm
+
+            n = shm.reclaim_stale(
+                shm.ring_name(self.spec.pipe_c2s, self._ident_prefix(slot.idx))
+            )
+            if n:
+                self._c_rings.inc(n)
+        p = self._factory(slot.idx)
+        if isinstance(p, mp.process.BaseProcess):
+            ensure_proc_terminate(p)
+            start_proc_mask_signal([p])
+        else:
+            p.start()
+        now = time.monotonic()
+        slot.proc = p
+        slot.started_t = now
+        if slot.ever_started:
+            self._c_respawns.inc()
+            self._respawn_times.append(now)
+            self._flight.record(
+                "server_respawn", slot=slot.idx, failures=slot.failures
+            )
+        else:
+            slot.ever_started = True
+            self._c_spawns.inc()
+            self._flight.record("server_spawn", slot=slot.idx)

@@ -73,7 +73,7 @@ def prometheus_text(snapshots: Optional[Dict[str, Dict[str, dict]]] = None) -> s
 
 
 def export_scalars(
-    roles=("master", "predictor", "learner", "fleet"),
+    roles=("master", "predictor", "learner", "fleet", "orchestrator"),
     prefix: str = "tele/",
 ) -> Dict[str, float]:
     """Counters + gauges flattened to ``{"tele/<role>/<name>": value}`` for

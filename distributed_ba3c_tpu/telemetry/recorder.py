@@ -69,6 +69,18 @@ class FlightRecorder:
             for t, kind, fields in list(self._ring)
         ]
 
+    def events_since(self, t: float, kind: Optional[str] = None) -> list:
+        """Raw ``(t_monotonic, kind, fields)`` tuples newer than ``t``,
+        oldest first — the cheap polling read (no dict building) the fleet
+        supervisor uses to watch the master's prune stream without keeping
+        its own duplicate heartbeats (orchestrate/supervisor.py). ``kind``
+        filters to one event kind."""
+        return [
+            ev
+            for ev in list(self._ring)
+            if ev[0] > t and (kind is None or ev[1] == kind)
+        ]
+
     def dump(
         self, reason: str, path: Optional[str] = None, quiet: bool = False,
     ) -> Optional[str]:

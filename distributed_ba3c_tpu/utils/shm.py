@@ -75,6 +75,47 @@ def available() -> bool:
     return os.path.isdir(SHM_DIR) and os.access(SHM_DIR, os.W_OK)
 
 
+def ring_name(pipe_c2s: str, ident_prefix: str) -> str:
+    """The canonical ring name for one fleet x server slot.
+
+    THE single definition — the env server creates under this name
+    (envs/native.py) and the supervisor reclaims stale files under it
+    before a respawn (orchestrate/supervisor.py); computing it in two
+    places would let them drift and leak ~57 MB per crashed server. The
+    name must be STABLE across restarts of a slot (pipe pair + prefix
+    identify the slot; concurrent fleets differ in pipe address) so a
+    crashed server's stale file is renamed over, not accumulated.
+    """
+    import hashlib
+
+    fleet = hashlib.sha1(pipe_c2s.encode()).hexdigest()[:8]
+    return f"ba3c-ring-{fleet}-{ident_prefix}"
+
+
+def reclaim_stale(name: str) -> int:
+    """Remove a stale ring file (any size/shape) and its orphaned create
+    temps; returns how many files went away.
+
+    Safe ONLY when no live server owns the name — the supervisor calls it
+    with the slot's process known-dead. Unlinking (vs truncating) cannot
+    hurt a master still mapping the old inode: the inode lives until the
+    last mapping drops, exactly like create()'s rename-over. What this
+    adds over rename-over is the DIFFERENT-GEOMETRY case: a crashed
+    fleet's leftover file with another cap/B must never be attachable
+    between the respawned server's create and the master's attach, and
+    must never count against /dev/shm space twice.
+    """
+    removed = 0
+    path = ShmRing._path(name)
+    for p in [path] + glob.glob(path + ".new-*"):
+        try:
+            os.unlink(p)
+            removed += 1
+        except OSError:
+            pass
+    return removed
+
+
 class ShmRing:
     """A ``[cap, B, H, W]`` uint8 observation ring backed by /dev/shm.
 

@@ -1,16 +1,27 @@
 #!/usr/bin/env python
-"""Launch a native env-server fleet on an ACTOR host (BASELINE config #3).
+"""Launch a SUPERVISED native env-server fleet on an ACTOR host.
 
-The remote-actor topology: a learner runs `train.py --env zmq:<game>
---pipe_c2s tcp://0.0.0.0:C --pipe_s2c tcp://0.0.0.0:S`; each actor host runs
-this script pointed at the learner. Every server process hosts up to 16
-native envs stepped in lockstep (envs/native.py CppEnvServerProcess), each
-env indistinguishable on the wire from a SimulatorProcess — the reference's
-remote simulators spoke the same ipc/tcp pipe pair (SURVEY.md §2.12 plane 1,
-expected RL/simulator.py).
+The remote-actor topology (BASELINE config #3): a learner runs `train.py
+--env zmq:<game> --pipe_c2s tcp://0.0.0.0:C --pipe_s2c tcp://0.0.0.0:S`;
+each actor host runs this script pointed at the learner. Every server
+process hosts up to 16 native envs stepped in lockstep (envs/native.py
+CppEnvServerProcess), each env indistinguishable on the wire from a
+SimulatorProcess — the reference's remote simulators spoke the same
+ipc/tcp pipe pair (SURVEY.md §2.12 plane 1).
 
-No jax in this process or its children: actor hosts need only numpy + pyzmq
-+ the cpp/ shared object.
+Unlike the old spawn-and-walk-away launcher, the fleet is owned by a
+FleetSupervisor (docs/orchestration.md): crashed servers respawn with
+exponential backoff, stale /dev/shm rings from a previous crashed fleet
+are reclaimed at spawn (any cap — a leftover ring file with different
+geometry no longer wedges the slot), and a crash LOOP exhausts the
+restart budget and exits 1 so a host-level supervisor (systemd/k8s) can
+take over — the circuit breaker turns an infinite fork storm into one
+visible failure. With ``--fleet_min/--fleet_max`` plus the learner's
+``--telemetry_url``, the host autoscales its fleet against the LEARNER'S
+backpressure signals (``/json`` scrape endpoint, docs/observability.md).
+
+No jax in this process or its children: actor hosts need only numpy +
+pyzmq + the cpp/ shared object.
 
 Example (256 actors over 2 hosts, learner at 10.0.0.1):
   actor-host-1$ python scripts/launch_env_fleet.py --game pong --n_envs 128 \
@@ -19,6 +30,7 @@ Example (256 actors over 2 hosts, learner at 10.0.0.1):
 """
 
 import argparse
+import math
 import signal
 import sys
 import time
@@ -53,6 +65,32 @@ def main(argv=None) -> int:
         "drops the client — size this to the learner's config when it "
         "rejects the default",
     )
+    p.add_argument(
+        "--fleet_spec", default=None,
+        help="JSON FleetSpec file (docs/orchestration.md) — the fully "
+        "declarative path; overrides every fleet-shape flag above",
+    )
+    p.add_argument(
+        "--fleet_min", type=int, default=0,
+        help="autoscaler lower bound in server processes (0 = launch size)",
+    )
+    p.add_argument(
+        "--fleet_max", type=int, default=0,
+        help="autoscaler upper bound in server processes (0 = launch "
+        "size); with --telemetry_url this host grows/shrinks its fleet on "
+        "the learner's backpressure signals",
+    )
+    p.add_argument(
+        "--telemetry_url", default=None,
+        help="the learner's --telemetry_port endpoint (http://host:port) "
+        "— enables cross-host autoscaling between the fleet bounds",
+    )
+    p.add_argument("--autoscale_interval", type=float, default=2.0)
+    p.add_argument(
+        "--restart_budget", type=int, default=16,
+        help="respawns tolerated per 5-minute window before the circuit "
+        "opens and this launcher exits 1 (host-level supervisor's turn)",
+    )
     args = p.parse_args(argv)
 
     from distributed_ba3c_tpu.envs import native
@@ -61,53 +99,111 @@ def main(argv=None) -> int:
         print("native env core not built: run `make -C cpp`", file=sys.stderr)
         return 2
 
-    per = max(1, args.envs_per_proc)
-    procs = []
-    left = args.n_envs
-    i = args.base_idx
-    while left > 0:
-        procs.append(
-            native.CppEnvServerProcess(
-                i,
-                args.c2s,
-                args.s2c,
+    from distributed_ba3c_tpu.orchestrate import (
+        Autoscaler,
+        FleetSpec,
+        FleetSupervisor,
+        default_factory,
+        http_signals,
+    )
+
+    try:
+        if args.fleet_spec:
+            spec = FleetSpec.load(args.fleet_spec)
+            total_envs = spec.fleet_size * spec.envs_per_server
+        else:
+            per = max(1, args.envs_per_proc)
+            n_servers = max(1, math.ceil(args.n_envs / per))
+            lo = args.fleet_min or n_servers
+            hi = args.fleet_max or n_servers
+            if not lo <= n_servers <= hi:
+                raise ValueError(
+                    f"launch fleet size {n_servers} servers "
+                    f"({args.n_envs} envs / {per} per proc) is outside "
+                    f"[--fleet_min {lo}, --fleet_max {hi}] — size --n_envs "
+                    "inside the bounds"
+                )
+            spec = FleetSpec(
+                pipe_c2s=args.c2s,
+                pipe_s2c=args.s2c,
                 game=args.game,
-                n_envs=min(per, left),
+                envs_per_server=per,
                 frame_history=args.frame_history,
                 wire=args.wire,
                 shm_ring_cap=args.shm_ring_cap,
+                base_idx=args.base_idx,
+                fleet_size=n_servers,
+                fleet_min=lo,
+                fleet_max=hi,
+                restart_budget=args.restart_budget,
             )
+            total_envs = args.n_envs
+    except (OSError, ValueError) as e:
+        # a misconfigured fleet (bad bounds, typoed spec field, missing
+        # spec file) is a usage error, not a traceback
+        print(f"fleet spec error: {e}", file=sys.stderr)
+        return 2
+    supervisor = FleetSupervisor(
+        spec, factory=default_factory(spec, total_envs=total_envs)
+    )
+    scaler = None
+    if spec.fleet_max > spec.fleet_min:
+        if not args.telemetry_url:
+            print(
+                "--fleet_min/--fleet_max without --telemetry_url: an actor "
+                "host has no master in-process — autoscaling needs the "
+                "learner's /json endpoint",
+                file=sys.stderr,
+            )
+            return 2
+        scaler = Autoscaler(
+            supervisor,
+            http_signals(args.telemetry_url),
+            interval_s=args.autoscale_interval,
         )
-        left -= per
-        i += 1
-    for pr in procs:
-        pr.start()
+
+    supervisor.start()
+    if scaler is not None:
+        scaler.start()
     print(
-        f"fleet up: {args.n_envs} x {args.game} in {len(procs)} processes -> "
-        f"{args.c2s} / {args.s2c}",
+        f"fleet up: {total_envs} x {spec.game} in {supervisor.target} "
+        f"supervised processes -> {spec.pipe_c2s} / {spec.pipe_s2c}",
         flush=True,
     )
 
+    from distributed_ba3c_tpu import telemetry
+
+    deaths = telemetry.registry("orchestrator").counter("server_deaths_total")
     stop = []
     rc = 0
     signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
     signal.signal(signal.SIGINT, lambda *_: stop.append(1))
     try:
         while not stop:
-            for pr in procs:
-                if not pr.is_alive():
-                    # non-zero exit so a supervisor (systemd/k8s) restarts
-                    # the fleet instead of leaving the learner starved
-                    print(f"server {pr.name} died; shutting fleet down", file=sys.stderr)
-                    stop.append(1)
-                    rc = 1
-                    break
+            # --restart_budget 0 keeps the circuit permanently open (no
+            # respawns — the pre-supervisor contract): exit only once a
+            # server has actually died, not at launch
+            if supervisor.circuit_open and (
+                spec.restart_budget > 0 or deaths.value() > 0
+            ):
+                # the fleet is crash-looping beyond its budget: one loud
+                # exit (evidence already dumped by the breaker) instead of
+                # a starved learner behind a quietly-respawning launcher
+                print(
+                    "respawn circuit open — fleet degraded beyond its "
+                    "restart budget; exiting for the host supervisor",
+                    file=sys.stderr,
+                )
+                rc = 1
+                break
             time.sleep(1.0)
     finally:
-        for pr in procs:
-            pr.terminate()
-        for pr in procs:
-            pr.join(timeout=5)
+        if scaler is not None:
+            scaler.stop()
+            scaler.join(timeout=5)
+        supervisor.stop()
+        supervisor.join(timeout=5)
+        supervisor.close()
     return rc
 
 

@@ -1,0 +1,28 @@
+"""A8-clean: the idioms the real codebase uses — fleet roles ride the
+orchestrate/ supervisors; non-fleet subprocesses stay fine."""
+
+import subprocess
+
+from distributed_ba3c_tpu.orchestrate import (
+    FleetSpec,
+    FleetSupervisor,
+    LearnerSupervisor,
+    default_factory,
+)
+
+
+def build_fleet(c2s, s2c):
+    spec = FleetSpec(pipe_c2s=c2s, pipe_s2c=s2c, fleet_size=4, fleet_max=8)
+    # the supervisor owns spawn/respawn/scale; the factory only
+    # parameterizes each slot
+    return FleetSupervisor(spec, factory=default_factory(spec))
+
+
+def launch_learner(logdir, train_args):
+    # supervised learner: checkpoint failover without operator action
+    return LearnerSupervisor(logdir, train_args).run()
+
+
+def run_build_tool():
+    # non-fleet subprocess use is not A8's business
+    return subprocess.run(["make", "-C", "cpp"], check=True)

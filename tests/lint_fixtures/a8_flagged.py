@@ -1,0 +1,31 @@
+"""A8 fixture: fleet-role processes spawned outside orchestrate/ — every
+pattern here bypasses the supervisor's respawn/backoff/scale accounting."""
+
+import os
+import subprocess
+
+from distributed_ba3c_tpu.actors.simulator import SimulatorProcess
+from distributed_ba3c_tpu.envs import native
+
+
+def build_fleet(c2s, s2c, build_player):
+    # direct fleet-role construction: dies dead, nothing accounted
+    servers = [
+        native.CppEnvServerProcess(i, c2s, s2c, n_envs=16) for i in range(4)
+    ]
+    sims = [SimulatorProcess(i, c2s, s2c, build_player) for i in range(4)]
+    return servers + sims
+
+
+def launch_learner(logdir):
+    # unsupervised learner: no checkpoint failover when it dies
+    return subprocess.Popen(["python", "train.py", "--logdir", logdir])
+
+
+def launch_remote_fleet(host):
+    subprocess.run(["ssh", host, "python", "scripts/launch_env_fleet.py"])
+
+
+def fork_worker():
+    # the repo is spawn-context-only
+    return os.fork()

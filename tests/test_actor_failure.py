@@ -4,9 +4,14 @@ SURVEY.md §5 (failure detection): the reference tolerated NO actor loss —
 a dead SimulatorProcess silently starved its client slot forever. Here the
 master prunes silent clients after ``actor_timeout`` (actors/simulator.py
 ``_prune_dead_actors``) and the surviving actors keep the train queue fed.
-This test SIGKILLs one of three simulator processes mid-run and asserts
-both behaviors — the chaos case the unit tests of the pruning logic don't
-cover.
+The first test SIGKILLs one of three simulator processes mid-run and
+asserts both behaviors — the chaos case the unit tests of the pruning
+logic don't cover.
+
+The supervised-chain tests close the loop the orchestration subsystem
+added (docs/orchestration.md): SIGKILL → the master's account ticks
+(prune or incarnation reset) → the FleetSupervisor respawns the slot with
+backoff → the experience stream resumes — no operator in the loop.
 """
 
 from __future__ import annotations
@@ -122,3 +127,149 @@ def test_actor_killed_mid_run_is_pruned_and_plane_survives(tmp_path):
         predictor.join(timeout=5)
         for p in procs:
             p.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# supervised chain: SIGKILL -> master account -> respawn -> stream resumes
+# ---------------------------------------------------------------------------
+
+
+def _block_plane(tmp_path, actor_timeout, backoff_base_s):
+    """A supervised 2-server block-wire C++ fleet feeding a live master."""
+    from distributed_ba3c_tpu.envs import native
+    from distributed_ba3c_tpu.orchestrate import FleetSpec, FleetSupervisor
+
+    n_actions = native.CppBatchedEnv("pong", 1).num_actions
+    cfg = BA3CConfig(num_actions=n_actions)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=16)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, *cfg.state_shape), np.uint8)
+    )["params"]
+    predictor = BatchedPredictor(model, params, batch_size=8, num_threads=1)
+    predictor.warmup(cfg.state_shape)
+    c2s, s2c = f"ipc://{tmp_path}/c2s", f"ipc://{tmp_path}/s2c"
+    master = BA3CSimulatorMaster(
+        c2s, s2c, predictor,
+        gamma=cfg.gamma, local_time_max=cfg.local_time_max,
+        score_queue=queue.Queue(maxsize=1000),
+        actor_timeout=actor_timeout,
+    )
+    spec = FleetSpec(
+        pipe_c2s=c2s, pipe_s2c=s2c, game="pong", envs_per_server=4,
+        wire="block", fleet_size=2, fleet_min=2, fleet_max=2,
+        backoff_base_s=backoff_base_s, backoff_max_s=backoff_base_s,
+        stable_after_s=1.0, restart_budget=16, budget_window_s=60.0,
+    )
+    supervisor = FleetSupervisor(spec, poll_interval_s=0.1)
+    predictor.start()
+    master.start()
+    supervisor.start()
+    return predictor, master, supervisor
+
+
+def _close_plane(predictor, master, supervisor):
+    supervisor.stop()
+    supervisor.join(timeout=5)
+    supervisor.close()
+    master.close()
+    predictor.stop()
+    predictor.join(timeout=5)
+
+
+def _native_or_skip():
+    from distributed_ba3c_tpu.envs import native
+
+    if not native.available():
+        pytest.skip("cpp core not built")
+
+
+@pytest.mark.slow
+def test_sigkill_fast_respawn_lands_as_incarnation_reset(tmp_path):
+    """Respawn INSIDE the master's patience: the replacement server reuses
+    the slot's wire ident, its step counter restarts at 0, and the master
+    resets the incarnation instead of growing a second client — then the
+    stream resumes."""
+    _native_or_skip()
+    telemetry.configure(str(tmp_path))
+    predictor, master, supervisor = _block_plane(
+        tmp_path, actor_timeout=None, backoff_base_s=0.25
+    )
+    m = telemetry.registry("master")
+    o = telemetry.registry("orchestrator")
+    inc0 = m.counter("incarnation_resets_total").value()
+    respawn0 = o.counter("server_respawns_total").value()
+    try:
+        assert len(_drain(master, 32, 120)) >= 32
+        assert supervisor.sigkill_slot(0)
+        deadline = time.time() + 60
+        while (
+            o.counter("server_respawns_total").value() < respawn0 + 1
+            and time.time() < deadline
+        ):
+            time.sleep(0.2)
+        assert o.counter("server_respawns_total").value() >= respawn0 + 1
+        deadline = time.time() + 60
+        while (
+            m.counter("incarnation_resets_total").value() < inc0 + 1
+            and time.time() < deadline
+        ):
+            time.sleep(0.2)
+        assert m.counter("incarnation_resets_total").value() >= inc0 + 1
+        # the full loop closed: fresh experience flows from both slots
+        assert len(_drain(master, 32, 120)) >= 32
+        kinds = [e[1] for e in telemetry.flight_recorder().events_since(0)]
+        assert "server_death" in kinds
+        assert "server_respawn" in kinds
+        assert "incarnation_reset" in kinds
+    finally:
+        telemetry.configure(None)
+        _close_plane(predictor, master, supervisor)
+
+
+@pytest.mark.slow
+def test_sigkill_slow_respawn_chains_prune_then_respawn(tmp_path):
+    """Respawn SLOWER than the master's patience: the master prunes the
+    dead client first (counter + postmortem dump), then the supervisor's
+    backoff expires, the slot respawns as a brand-new client, and the
+    stream resumes."""
+    _native_or_skip()
+    telemetry.configure(str(tmp_path))
+    predictor, master, supervisor = _block_plane(
+        tmp_path, actor_timeout=2.0, backoff_base_s=6.0
+    )
+    m = telemetry.registry("master")
+    o = telemetry.registry("orchestrator")
+    pruned0 = m.counter("clients_pruned_total").value()
+    respawn0 = o.counter("server_respawns_total").value()
+    try:
+        assert len(_drain(master, 32, 120)) >= 32
+        assert supervisor.sigkill_slot(1)
+        # the master's account moves FIRST (prune at ~2s beats the 6s
+        # backoff) — the ordering IS the scenario under test
+        deadline = time.time() + 60
+        while (
+            m.counter("clients_pruned_total").value() < pruned0 + 1
+            and time.time() < deadline
+        ):
+            time.sleep(0.2)
+        assert m.counter("clients_pruned_total").value() >= pruned0 + 1
+        assert o.counter("server_respawns_total").value() == respawn0, (
+            "respawn beat the prune — backoff did not hold"
+        )
+        deadline = time.time() + 120
+        while (
+            o.counter("server_respawns_total").value() < respawn0 + 1
+            and time.time() < deadline
+        ):
+            time.sleep(0.2)
+        assert o.counter("server_respawns_total").value() >= respawn0 + 1
+        assert len(_drain(master, 32, 120)) >= 32
+        # the prune left its dump on disk before the respawn (postmortem
+        # evidence ordering, same contract as the unsupervised test above)
+        dump_path = str(tmp_path / f"flight-{os.getpid()}.json")
+        assert os.path.isfile(dump_path)
+        doc = json.load(open(dump_path))
+        assert any(e["kind"] == "prune" for e in doc["events"])
+    finally:
+        telemetry.configure(None)
+        _close_plane(predictor, master, supervisor)

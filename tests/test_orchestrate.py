@@ -1,0 +1,651 @@
+"""Orchestration subsystem units: spec, supervisor, autoscaler, learner
+failover, chaos — plus the stale-shm-ring regression.
+
+The fast tests drive the supervisor with duck-typed fake processes (the
+factory contract is explicitly process-LIKE), so respawn/backoff/circuit/
+scale logic is exercised in milliseconds with no spawn in the loop. The
+slow tests run the real thing: a supervised C++ block-wire fleet feeding a
+live master (tests/test_actor_failure.py holds the full SIGKILL chain).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import stat
+import sys
+import time
+
+import pytest
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.orchestrate import (
+    Autoscaler,
+    AutoscalerPolicy,
+    ChaosMonkey,
+    FleetSpec,
+    FleetSupervisor,
+    LearnerSupervisor,
+    finalized_step,
+)
+from distributed_ba3c_tpu.telemetry import exporters
+from distributed_ba3c_tpu.utils import shm
+
+
+class FakeProc:
+    """Duck-typed slot process: instant, killable, inspectable."""
+
+    def __init__(self, idx):
+        self.idx = idx
+        self._alive = False
+        self.exitcode = None
+        self.started = 0
+        self.pid = None  # no real pid: sigkill_slot falls back to .kill()
+
+    def start(self):
+        self._alive = True
+        self.started += 1
+
+    def is_alive(self):
+        return self._alive
+
+    def terminate(self):
+        self._alive = False
+        self.exitcode = -15
+
+    def kill(self):
+        self._alive = False
+        self.exitcode = -9
+
+    def join(self, timeout=None):
+        pass
+
+
+def _spec(**kw):
+    base = dict(
+        pipe_c2s="ipc:///tmp/t-c2s",
+        pipe_s2c="ipc:///tmp/t-s2c",
+        fleet_size=3,
+        fleet_min=1,
+        fleet_max=6,
+        backoff_base_s=0.02,
+        backoff_max_s=0.1,
+        stable_after_s=10.0,
+        restart_budget=32,
+        budget_window_s=60.0,
+    )
+    base.update(kw)
+    return FleetSpec(**base)
+
+
+def _sup(spec=None, **kw):
+    spec = spec or _spec()
+    made = []
+
+    def factory(i):
+        p = FakeProc(i)
+        made.append(p)
+        return p
+
+    sup = FleetSupervisor(
+        spec, factory=factory, poll_interval_s=0.02, **kw
+    )
+    return sup, made
+
+
+def _wait(cond, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _counter(name, role="orchestrator"):
+    return telemetry.registry(role).counter(name).value()
+
+
+# ---------------------------------------------------------------------------
+# FleetSpec
+# ---------------------------------------------------------------------------
+
+
+def test_spec_json_round_trip():
+    spec = _spec(game="breakout", wire="block-shm", envs_per_server=8)
+    again = FleetSpec.from_json(spec.to_json())
+    assert again == spec
+
+
+def test_spec_rejects_unknown_field_and_bad_bounds():
+    with pytest.raises(ValueError, match="unknown fleet spec fields"):
+        FleetSpec.from_json(json.dumps({"fleet_maximum": 4}))
+    with pytest.raises(ValueError, match="fleet_min"):
+        _spec(fleet_min=5, fleet_max=3)
+    with pytest.raises(ValueError, match="outside"):
+        _spec(fleet_size=9, fleet_max=6)
+    with pytest.raises(ValueError, match="wire"):
+        _spec(wire="carrier-pigeon")
+
+
+def test_spec_backoff_schedule_doubles_and_caps():
+    spec = _spec(backoff_base_s=0.5, backoff_max_s=3.0)
+    assert spec.backoff_s(1) == 0.5
+    assert spec.backoff_s(2) == 1.0
+    assert spec.backoff_s(3) == 2.0
+    assert spec.backoff_s(4) == 3.0  # capped
+    assert spec.backoff_s(50) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# FleetSupervisor (fake processes)
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_spawns_respawns_and_accounts(tmp_path):
+    telemetry.configure(str(tmp_path))
+    sup, made = _sup()
+    deaths0 = _counter("server_deaths_total")
+    respawns0 = _counter("server_respawns_total")
+    try:
+        sup.start()
+        assert sup.live_count() == 3
+        assert len(made) == 3
+        # SIGKILL one slot: reaped, accounted, respawned after backoff
+        assert sup.sigkill_slot(1)
+        _wait(lambda: sup.live_count() == 3, msg="respawn")
+        assert _counter("server_deaths_total") == deaths0 + 1
+        assert _counter("server_respawns_total") == respawns0 + 1
+        assert len(made) == 4 and made[3].idx == 1
+        reg = telemetry.registry("orchestrator")
+        assert reg.gauge("fleet_target_size").value() == 3
+        assert reg.gauge("fleet_live_size").value() == 3
+        kinds = [e[1] for e in telemetry.flight_recorder().events_since(0)]
+        assert "server_death" in kinds and "server_respawn" in kinds
+    finally:
+        telemetry.configure(None)
+        sup.stop()
+        sup.join(timeout=2)
+        sup.close()
+
+
+def test_supervisor_scale_events_and_gauge_pair():
+    sup, made = _sup()
+    up0, down0 = _counter("scale_up_total"), _counter("scale_down_total")
+    try:
+        sup.start()
+        sup.scale_to(5, "test growth")
+        _wait(lambda: sup.live_count() == 5, msg="scale up")
+        assert _counter("scale_up_total") == up0 + 1
+        # clamped at the spec bounds, no event for a no-op
+        assert sup.scale_to(99, "clamped") == 6
+        _wait(lambda: sup.live_count() == 6, msg="scale to max")
+        assert sup.scale_to(99, "noop") == 6
+        assert _counter("scale_up_total") == up0 + 2
+        sup.scale_to(1, "test shrink")
+        _wait(lambda: sup.live_count() == 1, msg="scale down")
+        assert _counter("scale_down_total") == down0 + 1
+        # the scaled-down-on-purpose signature: target == live == 1
+        reg = telemetry.registry("orchestrator")
+        assert reg.gauge("fleet_target_size").value() == 1
+        assert reg.gauge("fleet_live_size").value() == 1
+    finally:
+        sup.stop()
+        sup.join(timeout=2)
+        sup.close()
+
+
+def test_supervisor_gauges_reach_metrics_and_stat_json_shapes():
+    """Satellite: fleet_target_size / fleet_live_size must be visible on
+    BOTH export surfaces — /metrics (Prometheus text) and the stat.json
+    bridge (export_scalars) — so a scrape can tell 'scaled down on
+    purpose' from 'lost half the fleet'."""
+    sup, _ = _sup()
+    try:
+        sup.start()
+        text = exporters.prometheus_text()
+        assert 'ba3c_fleet_target_size{role="orchestrator"} 3' in text
+        assert 'ba3c_fleet_live_size{role="orchestrator"} 3' in text
+        scalars = exporters.export_scalars()
+        assert scalars["tele/orchestrator/fleet_target_size"] == 3.0
+        assert scalars["tele/orchestrator/fleet_live_size"] == 3.0
+    finally:
+        sup.stop()
+        sup.join(timeout=2)
+        sup.close()
+
+
+def test_supervisor_circuit_breaker_opens_and_closes():
+    # budget 3 respawns / 0.6 s window; a crash loop (factory whose procs
+    # die instantly at the next tick) must trip the breaker
+    spec = _spec(
+        fleet_size=1, fleet_min=1, fleet_max=2,
+        backoff_base_s=0.0, backoff_max_s=0.0,
+        restart_budget=3, budget_window_s=0.6,
+    )
+    crashing = []
+
+    def factory(i):
+        p = FakeProc(i)
+        crashing.append(p)
+        return p
+
+    sup = FleetSupervisor(spec, factory=factory, poll_interval_s=0.01)
+    trips0 = _counter("circuit_trips_total")
+    try:
+        sup.start()
+        # crash loop: kill whatever is alive as soon as it spawns
+        deadline = time.monotonic() + 5
+        while not sup.circuit_open and time.monotonic() < deadline:
+            for p in crashing:
+                if p.is_alive():
+                    p.kill()
+            time.sleep(0.005)
+        assert sup.circuit_open, "circuit never opened under a crash loop"
+        assert _counter("circuit_trips_total") == trips0 + 1
+        n_at_trip = len(crashing)
+        time.sleep(0.1)
+        assert len(crashing) == n_at_trip, "respawns continued while open"
+        # window drains -> breaker half-opens and respawns resume
+        _wait(
+            lambda: not sup.circuit_open, timeout=5, msg="circuit close"
+        )
+        _wait(lambda: sup.live_count() == 1, msg="respawn after close")
+        kinds = [e[1] for e in telemetry.flight_recorder().events_since(0)]
+        assert "circuit_open" in kinds and "circuit_close" in kinds
+    finally:
+        sup.stop()
+        sup.join(timeout=2)
+        sup.close()
+
+
+def test_supervisor_restart_budget_zero_disables_respawn():
+    spec = _spec(fleet_size=2, restart_budget=0, backoff_base_s=0.0)
+    sup, made = _sup(spec)
+    try:
+        sup.start()
+        assert sup.circuit_open  # permanently, by spec
+        sup.sigkill_slot(0)
+        time.sleep(0.2)
+        assert sup.live_count() == 1
+        assert len(made) == 2  # never respawned
+    finally:
+        sup.stop()
+        sup.join(timeout=2)
+        sup.close()
+
+
+def test_supervisor_kills_wedged_slot_on_master_prune_event():
+    """The telemetry-registry liveness path: a prune event naming a slot
+    whose process is still ALIVE means the master gave up on a wedged
+    server — the supervisor must kill it and let the respawn path run."""
+    sup, made = _sup()
+    wedged0 = _counter("wedged_kills_total")
+    try:
+        sup.start()
+        victim = made[2]
+        assert victim.is_alive()
+        # exactly what SimulatorMaster._prune_dead_actors records
+        telemetry.record("prune", ident=repr(b"cppsim-2*block"), silent_s=12.0)
+        _wait(
+            lambda: _counter("wedged_kills_total") == wedged0 + 1,
+            msg="wedged kill",
+        )
+        assert not victim.is_alive() and victim.exitcode == -9
+        _wait(lambda: sup.live_count() == 3, msg="respawn after wedge")
+    finally:
+        sup.stop()
+        sup.join(timeout=2)
+        sup.close()
+
+
+def test_supervisor_ident_mapping_is_delimiter_exact():
+    sup, _ = _sup(_spec(fleet_size=6, fleet_max=12, base_idx=0))
+    try:
+        sup.start()  # mapping covers the slots that exist
+        assert sup._slot_for_ident(repr(b"cppsim-5*block")) == 5
+        assert sup._slot_for_ident(repr(b"cppsim-5-3")) == 5
+        # cppsim-5 must not match inside cppsim-50's ident
+        assert sup._slot_for_ident(repr(b"cppsim-50*block")) is None
+        assert sup._slot_for_ident(repr(b"someone-else")) is None
+    finally:
+        sup.stop()
+        sup.join(timeout=2)
+        sup.close()
+
+
+def test_supervisor_prune_event_for_dead_slot_is_not_double_killed():
+    """A prune recorded BEFORE the current incarnation started refers to
+    its predecessor — it must not kill the healthy replacement."""
+    sup, made = _sup()
+    wedged0 = _counter("wedged_kills_total")
+    try:
+        sup.start()
+        # stale prune: timestamped before every slot's started_t
+        stale_t = time.monotonic() - 100
+        sup._flight._ring.append((stale_t, "prune", {"ident": repr(b"cppsim-0*block")}))
+        time.sleep(0.2)
+        assert made[0].is_alive()
+        assert _counter("wedged_kills_total") == wedged0
+    finally:
+        sup.stop()
+        sup.join(timeout=2)
+        sup.close()
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler
+# ---------------------------------------------------------------------------
+
+
+def test_policy_baselines_then_scales_up_on_starvation():
+    pol = AutoscalerPolicy(patience=2, cooldown_ticks=0)
+    starved = {"queue_depth": 0, "queue_maxsize": 100, "blocked_puts_total": 0}
+    assert pol.decide(starved) == (0, "")  # baseline tick
+    assert pol.decide(starved)[0] == 0  # patience 1/2
+    delta, reason = pol.decide(starved)
+    assert delta == 1 and "starved" in reason
+
+
+def test_policy_scales_down_on_blocked_put_delta_even_at_low_fill():
+    pol = AutoscalerPolicy(patience=2, cooldown_ticks=0)
+    s = {"queue_depth": 10, "queue_maxsize": 100, "blocked_puts_total": 0}
+    pol.decide(s)  # baseline
+    s = dict(s, blocked_puts_total=5)  # the master WAITED on a full queue
+    assert pol.decide(s)[0] == 0
+    s = dict(s, blocked_puts_total=9)
+    delta, reason = pol.decide(s)
+    assert delta == -1 and "backpressure" in reason
+
+
+def test_policy_deadband_and_cooldown():
+    pol = AutoscalerPolicy(
+        low_fill=0.2, high_fill=0.8, patience=1, cooldown_ticks=2
+    )
+    mid = {"queue_depth": 50, "queue_maxsize": 100, "blocked_puts_total": 0}
+    low = {"queue_depth": 0, "queue_maxsize": 100, "blocked_puts_total": 0}
+    pol.decide(mid)  # baseline
+    assert pol.decide(mid) == (0, "")  # inside the deadband: no move
+    assert pol.decide(low)[0] == 1
+    # cooldown: the next 2 ticks are ignored even though still starved
+    assert pol.decide(low)[0] == 0
+    assert pol.decide(low)[0] == 0
+    assert pol.decide(low)[0] == 1
+
+
+def test_autoscaler_drives_supervisor_between_bounds():
+    sup, _ = _sup(_spec(fleet_size=2, fleet_min=1, fleet_max=4))
+    signals = {"queue_depth": 0, "queue_maxsize": 100, "blocked_puts_total": 0}
+    scaler = Autoscaler(
+        sup,
+        lambda: dict(signals),
+        policy=AutoscalerPolicy(patience=1, cooldown_ticks=0),
+        interval_s=60,  # ticks driven by hand below
+    )
+    try:
+        sup.start()
+        scaler.tick()  # baseline
+        for _ in range(3):
+            scaler.tick()
+        assert sup.target == 4  # grew to max, clamped there
+        _wait(lambda: sup.live_count() == 4, msg="autoscale growth")
+        signals.update(queue_depth=95)
+        for _ in range(4):
+            scaler.tick()
+        assert sup.target == 1  # shrank to min, clamped there
+        kinds = [e[1] for e in telemetry.flight_recorder().events_since(0)]
+        assert "scale_decision" in kinds
+    finally:
+        sup.stop()
+        sup.join(timeout=2)
+        sup.close()
+
+
+def test_policy_unknown_capacity_never_reads_as_starved():
+    """Review regression: queue_maxsize 0 (unbounded queue, or a scrape
+    target without the train_queue_capacity gauge) means the fill is
+    UNKNOWN — the policy must not ratchet the fleet to fleet_max on a
+    sentinel. Blocked-put deltas still drive scale-down capacity-free."""
+    pol = AutoscalerPolicy(patience=1, cooldown_ticks=0)
+    s = {"queue_depth": 0, "queue_maxsize": 0, "blocked_puts_total": 0}
+    pol.decide(s)  # baseline
+    for _ in range(5):
+        assert pol.decide(s) == (0, "")
+    s2 = dict(s, blocked_puts_total=7)
+    delta, reason = pol.decide(s2)
+    assert delta == -1 and "unknown" in reason
+
+
+def test_scale_down_reaps_retiree_and_regrow_waits_for_it():
+    """Review regression: a retired slot's process must be reaped (not
+    left a zombie holding the slot's wire identity), and re-growing the
+    slot must wait until the retiree is fully dead."""
+
+    class SlowExit(FakeProc):
+        def terminate(self):
+            pass  # ignores SIGTERM: only kill() works
+
+    sup = FleetSupervisor(
+        _spec(fleet_size=2), factory=lambda i: SlowExit(i),
+        poll_interval_s=0.02,
+    )
+    try:
+        sup.start()
+        made_before = sup.live_slots()
+        retiree = dict(made_before)[1]
+        sup.scale_to(1, "shrink")
+        # the retiree ignored terminate(); the reaper must not SIGKILL it
+        # before the grace — but must also not let a re-grown slot 1
+        # spawn while it lives
+        sup.scale_to(2, "regrow")
+        time.sleep(0.2)
+        with sup._lock:
+            slot1 = sup._slots[1]
+            assert slot1.proc is None or slot1.proc is not retiree
+        if retiree.is_alive():
+            # before the 5 s grace the slot must still be waiting
+            with sup._lock:
+                assert sup._slots[1].proc is None
+            # close() must finish the retiree off
+            sup.stop()
+            sup.join(timeout=2)
+            sup.close()
+            assert not retiree.is_alive()
+            return
+        _wait(lambda: sup.live_count() == 2, msg="regrow after reap")
+    finally:
+        sup.stop()
+        sup.join(timeout=2)
+        sup.close()
+
+
+def test_autoscaler_survives_signal_scrape_failure():
+    sup, _ = _sup()
+    err0 = _counter("autoscale_signal_errors_total")
+
+    def broken():
+        raise ConnectionError("endpoint gone")
+
+    scaler = Autoscaler(sup, broken, interval_s=60)
+    scaler.tick()
+    assert _counter("autoscale_signal_errors_total") == err0 + 1
+    sup.close()
+
+
+# ---------------------------------------------------------------------------
+# ChaosMonkey
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_monkey_kill_sequence_is_seeded_and_accounted():
+    kills0 = _counter("chaos_kills_total")
+    seqs = []
+    for _ in range(2):
+        spec = _spec(fleet_size=4, restart_budget=0)  # no respawn: victims stay dead
+        sup, made = _sup(spec)
+        sup.start()
+        monkey = ChaosMonkey(sup, max_kills=3, seed=7)
+        victims = [monkey.kill_one() for _ in range(3)]
+        seqs.append(victims)
+        assert monkey.kills == 3
+        assert all(v is not None for v in victims)
+        sup.stop()
+        sup.join(timeout=2)
+        sup.close()
+    assert seqs[0] == seqs[1], "same seed must replay the same kills"
+    assert _counter("chaos_kills_total") == kills0 + 6
+    kinds = [e[1] for e in telemetry.flight_recorder().events_since(0)]
+    assert "chaos_kill" in kinds
+
+
+# ---------------------------------------------------------------------------
+# stale shm-ring reclaim (satellite regression)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not shm.available(), reason="/dev/shm unavailable")
+def test_spawn_reclaims_stale_ring_of_any_geometry():
+    """Regression: a crashed fleet's leftover ring file — with a DIFFERENT
+    cap than the new spec — plus an orphaned create temp must be reclaimed
+    at spawn, not wedge the slot or leak /dev/shm space."""
+    spec = _spec(wire="block-shm", fleet_size=1, pipe_c2s="ipc:///tmp/reclaim-c2s")
+    name = shm.ring_name(spec.pipe_c2s, "cppsim-0")
+    path = os.path.join(shm.SHM_DIR, name)
+    with open(path, "wb") as fh:
+        fh.truncate(123456)  # stale ring, wrong geometry
+    with open(path + ".new-4242", "wb") as fh:
+        fh.truncate(77)  # orphaned create temp from a dead creator
+    rings0 = _counter("rings_reclaimed_total")
+    sup, _ = _sup(spec)
+    try:
+        sup.start()
+        assert not os.path.exists(path)
+        assert not os.path.exists(path + ".new-4242")
+        assert _counter("rings_reclaimed_total") == rings0 + 2
+    finally:
+        sup.stop()
+        sup.join(timeout=2)
+        sup.close()
+
+
+def test_ring_name_is_stable_per_fleet_and_slot():
+    a = shm.ring_name("tcp://10.0.0.1:5555", "cppsim-3")
+    assert a == shm.ring_name("tcp://10.0.0.1:5555", "cppsim-3")
+    assert a != shm.ring_name("tcp://10.0.0.1:5556", "cppsim-3")
+    assert a != shm.ring_name("tcp://10.0.0.1:5555", "cppsim-4")
+
+
+# ---------------------------------------------------------------------------
+# LearnerSupervisor (stubbed train.py — jax-free, fast)
+# ---------------------------------------------------------------------------
+
+_STUB = r"""#!/usr/bin/env python3
+import json, os, sys
+logdir = sys.argv[sys.argv.index("--logdir") + 1]
+calls_path = os.environ["STUB_CALLS"]
+calls = json.load(open(calls_path)) if os.path.exists(calls_path) else []
+calls.append(sys.argv[1:])
+json.dump(calls, open(calls_path, "w"))
+ck = os.path.join(logdir, "checkpoints")
+os.makedirs(ck, exist_ok=True)
+if len(calls) == 1:
+    # first attempt: finalize a checkpoint, then 'crash'
+    json.dump({"all": [40], "latest": 40},
+              open(os.path.join(ck, "checkpoint.json"), "w"))
+    sys.exit(1)
+sys.exit(0)
+"""
+
+
+def _write_stub(tmp_path):
+    stub = tmp_path / "train_stub.py"
+    stub.write_text(_STUB)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    return str(stub)
+
+
+def test_learner_failover_resumes_from_finalized_checkpoint(
+    tmp_path, monkeypatch
+):
+    calls_path = tmp_path / "calls.json"
+    monkeypatch.setenv("STUB_CALLS", str(calls_path))
+    logdir = str(tmp_path / "run")
+    resumes0 = _counter("learner_resumes_total")
+    sup = LearnerSupervisor(
+        logdir,
+        ["--logdir", logdir],
+        max_restarts=3,
+        train_py=_write_stub(tmp_path),
+        python=sys.executable,
+        poll_s=0.05,
+    )
+    assert sup.run() == 0
+    calls = json.loads(calls_path.read_text())
+    assert len(calls) == 2
+    assert "--load" not in calls[0], "fresh launch must not --load"
+    i = calls[1].index("--load")
+    assert calls[1][i + 1] == os.path.join(logdir, "checkpoints")
+    assert _counter("learner_resumes_total") == resumes0 + 1
+    failovers = [
+        f
+        for _, k, f in telemetry.flight_recorder().events_since(0)
+        if k == "learner_failover"
+    ]
+    assert failovers and failovers[-1]["resume_step"] == 40
+
+
+def test_learner_gives_up_after_restart_budget(tmp_path, monkeypatch):
+    stub = tmp_path / "always_dies.py"
+    stub.write_text("import sys\nsys.exit(3)\n")
+    monkeypatch.setenv("STUB_CALLS", str(tmp_path / "unused.json"))
+    logdir = str(tmp_path / "run")
+    sup = LearnerSupervisor(
+        logdir, ["--logdir", logdir], max_restarts=2,
+        train_py=str(stub), python=sys.executable, poll_s=0.05,
+    )
+    assert sup.run() == 3
+    kinds = [e[1] for e in telemetry.flight_recorder().events_since(0)]
+    assert "learner_giveup" in kinds
+
+
+def test_learner_rejects_explicit_load():
+    with pytest.raises(ValueError, match="--load belongs to the supervisor"):
+        LearnerSupervisor("x", ["--logdir", "x", "--load", "y"])
+
+
+def test_learner_rejects_mismatched_or_missing_logdir():
+    """Review regression: a train-args --logdir pointing elsewhere would
+    make the watchdog stall-kill a healthy learner and resume from a
+    directory the child never writes."""
+    with pytest.raises(ValueError, match="does not match"):
+        LearnerSupervisor("runs/a", ["--logdir", "runs/b"])
+    with pytest.raises(ValueError, match="must include --logdir"):
+        LearnerSupervisor("runs/a", ["--env", "fake"])
+
+
+def test_learner_stall_watchdog_kills_silent_child(tmp_path):
+    stub = tmp_path / "hangs.py"
+    stub.write_text("import time\ntime.sleep(600)\n")
+    logdir = str(tmp_path / "run")
+    os.makedirs(logdir, exist_ok=True)
+    sup = LearnerSupervisor(
+        logdir, ["--logdir", logdir], max_restarts=0,
+        stall_secs=0.5, startup_grace_s=0.0,
+        train_py=str(stub), python=sys.executable, poll_s=0.05,
+    )
+    t0 = time.monotonic()
+    rc = sup.run()
+    assert rc != 0
+    assert time.monotonic() - t0 < 30, "stall watchdog never fired"
+    kinds = [e[1] for e in telemetry.flight_recorder().events_since(0)]
+    assert "learner_stall_kill" in kinds
+
+
+def test_finalized_step_gate(tmp_path):
+    ck = tmp_path / "checkpoints"
+    ck.mkdir()
+    assert finalized_step(str(ck)) is None  # no metadata at all
+    (ck / "checkpoint.json").write_text(json.dumps({"latest": None}))
+    assert finalized_step(str(ck)) is None  # dir exists, nothing finalized
+    (ck / "checkpoint.json").write_text(json.dumps({"latest": 120}))
+    assert finalized_step(str(ck)) == 120

@@ -522,6 +522,84 @@ class AdhocMetricRule(Rule):
         return False
 
 
+#: fleet-role process classes: anything whose lifecycle the supervisor owns
+_FLEET_PROC_SUFFIXES = (".CppEnvServerProcess", ".SimulatorProcess")
+_FLEET_PROC_BARE = {"CppEnvServerProcess", "SimulatorProcess"}
+
+#: fleet-role entry points a subprocess spawn may name
+_FLEET_ENTRY_FRAGMENTS = ("train.py", "launch_env_fleet")
+
+_SUBPROCESS_SPAWNERS = {
+    "subprocess.Popen", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+}
+
+_RAW_FORKS = {"os.fork", "os.forkpty", "os.posix_spawn", "os.posix_spawnp"}
+
+
+class UnsupervisedFleetSpawnRule(Rule):
+    """A8: fleet-role process spawned outside ``orchestrate/``.
+
+    The orchestration subsystem (distributed_ba3c_tpu/orchestrate/,
+    docs/orchestration.md) owns the fleet lifecycle: respawn with backoff,
+    the restart-budget circuit breaker, stale shm-ring reclaim, scale
+    accounting as ``tele/orchestrator/*``. A ``CppEnvServerProcess``/
+    ``SimulatorProcess`` constructed-and-started directly — or a
+    ``subprocess.Popen`` of ``train.py``/``launch_env_fleet`` — bypasses
+    all of it: the process that dies stays dead and nothing is accounted.
+    Route fleet roles through ``FleetSupervisor``/``LearnerSupervisor``,
+    or suppress with the justification for why this spawn's lifecycle is
+    otherwise owned (a factory HANDED to the supervisor parameterizes the
+    slot rather than spawning it — that is the sanctioned suppression).
+    ``os.fork`` and friends are flagged unconditionally: the repo is
+    spawn-context-only (a fork from the threaded trainer can deadlock the
+    child — envs/simulator.py).
+    """
+
+    id = "A8"
+    name = "unsupervised-fleet-spawn"
+    summary = "fleet-role process spawned outside orchestrate/ bypasses the supervisor"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if "orchestrate" in ctx.path.replace(os.sep, "/").split("/"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.info.resolve(node.func)
+            if resolved is None:
+                continue
+            if (
+                resolved in _FLEET_PROC_BARE
+                or resolved.endswith(_FLEET_PROC_SUFFIXES)
+            ):
+                yield ctx.finding(
+                    self, node,
+                    f"direct {resolved.rsplit('.', 1)[-1]} construction — "
+                    "fleet-role processes belong to a FleetSupervisor "
+                    "(respawn/backoff/scale accounting; "
+                    "docs/orchestration.md)",
+                )
+            elif resolved in _RAW_FORKS:
+                yield ctx.finding(
+                    self, node,
+                    f"{resolved}() — the repo is spawn-context-only, and "
+                    "fleet roles belong to the orchestrate/ supervisors",
+                )
+            elif resolved in _SUBPROCESS_SPAWNERS and any(
+                frag in s
+                for s in _string_literals(node)
+                for frag in _FLEET_ENTRY_FRAGMENTS
+            ):
+                yield ctx.finding(
+                    self, node,
+                    "subprocess spawn of a fleet-role entry point — a "
+                    "supervised learner belongs to LearnerSupervisor "
+                    "(checkpoint failover + accounting), a fleet to "
+                    "FleetSupervisor (docs/orchestration.md)",
+                )
+
+
 ACTOR_RULES = [
     BareThreadRule(),
     BlockingQueueOpRule(),
@@ -530,4 +608,5 @@ ACTOR_RULES = [
     PrivateImportRule(),
     PerEnvWireLoopRule(),
     AdhocMetricRule(),
+    UnsupervisedFleetSpawnRule(),
 ]
