@@ -18,6 +18,9 @@ The registered entry points (one per hot-path jit site):
     parallel.train_step   the sync DP step      (parallel/train_step.py)
     parallel.vtrace_step  the V-trace step      (parallel/vtrace_step.py)
     fused.step            the fused rollout+update step (fused/loop.py)
+    fused.actor           the overlap rollout program (fused/overlap.py) —
+                          donation-aliased env carry, collective-free
+    fused.learner         the overlap V-trace learner (fused/overlap.py)
     fused.greedy_eval     the on-device greedy Evaluator (fused/loop.py)
     predict.server        the batched action-server forward (predict/server.py)
 
@@ -285,15 +288,19 @@ def _grad_shapes(params_avals) -> List[Tuple[int, ...]]:
     ]
 
 
-def _donated_indices(state_avals, exempt: Tuple[str, ...] = ()) -> List[int]:
+def _donated_indices(state_avals, exempt: Tuple[str, ...] = (),
+                     offset: int = 0) -> List[int]:
     """Flattened input indices of the donated arg's non-scalar leaves.
 
-    The donated state is always positional arg 0, so its leaves occupy the
+    The donated state is usually positional arg 0, so its leaves occupy the
     first positions of the jit's flattened input list — which is the HLO
-    parameter numbering the compiled module's alias table uses. ``exempt``
-    names leaf-path fragments excluded from the T2 requirement; every
-    exemption must carry a justification comment at the registration site
-    (the manifest's exact ``aliased_inputs`` count still pins the total).
+    parameter numbering the compiled module's alias table uses. When the
+    donated arg comes AFTER others (the overlap actor donates arg 1, its
+    env carry, while arg 0 is the params snapshot), ``offset`` is the leaf
+    count of the preceding args. ``exempt`` names leaf-path fragments
+    excluded from the T2 requirement; every exemption must carry a
+    justification comment at the registration site (the manifest's exact
+    ``aliased_inputs`` count still pins the total).
     """
     import jax
 
@@ -306,7 +313,7 @@ def _donated_indices(state_avals, exempt: Tuple[str, ...] = ()) -> List[int]:
         key = jax.tree_util.keystr(path)
         if any(frag in key for frag in exempt):
             continue
-        out.append(i)
+        out.append(offset + i)
     return out
 
 
@@ -403,6 +410,87 @@ def _build_fused_step() -> TraceTarget:
         donated_nonscalar_indices=_donated_indices(
             state, exempt=("ep_return_sum",)
         ),
+    )
+
+
+@register_entry("fused.actor")
+def _build_overlap_actor() -> TraceTarget:
+    import jax
+
+    from distributed_ba3c_tpu.envs.jaxenv import pong
+    from distributed_ba3c_tpu.fused.loop import create_fused_state
+    from distributed_ba3c_tpu.fused.overlap import ActorState, make_overlap_step
+
+    cfg, model, opt = _canonical_parts()
+    mesh = canonical_mesh()
+    n_envs = 2 * CANONICAL_MESH_DEVICES  # 2 envs per canonical shard
+    step = make_overlap_step(model, opt, cfg, mesh, pong, rollout_len=4)
+    state = jax.eval_shape(
+        lambda k: create_fused_state(
+            k, model, cfg, opt, pong, n_envs,
+            n_shards=CANONICAL_MESH_DEVICES,
+        ),
+        _key_aval(),
+    )
+    astate = ActorState(
+        env_state=state.env_state,
+        obs_stack=state.obs_stack,
+        key=state.key,
+        ep_return=state.ep_return,
+        ep_count=state.ep_count,
+        ep_return_sum=state.ep_return_sum,
+    )
+    params = state.train.params
+    return TraceTarget(
+        name="fused.actor",
+        jit_fn=step.actor_jit,
+        # arg 0 is the params SNAPSHOT (fused.prep's output), arg 1 the
+        # donated env carry — its leaves sit after every params leaf in
+        # the HLO parameter numbering
+        args=(params, astate),
+        grad_shapes=None,
+        donated_nonscalar_indices=_donated_indices(
+            astate,
+            offset=len(jax.tree_util.tree_leaves(params)),
+        ),
+        # the overlap schedule's whole premise: the rollout program has
+        # nothing to wait on — single-chip form must be collective-free
+        allow_collectives=False,
+    )
+
+
+@register_entry("fused.learner")
+def _build_overlap_learner() -> TraceTarget:
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ba3c_tpu.envs.jaxenv import pong
+    from distributed_ba3c_tpu.fused.overlap import TrajBlock, make_overlap_step
+
+    cfg, model, opt = _canonical_parts()
+    mesh = canonical_mesh()
+    step = make_overlap_step(model, opt, cfg, mesh, pong, rollout_len=4)
+    train = _state_avals(model, cfg, opt)
+    T, B = 4, 2 * CANONICAL_MESH_DEVICES  # one canonical actor block
+    sds = jax.ShapeDtypeStruct
+    block = TrajBlock(
+        states=sds((T, B, *cfg.state_shape), jnp.uint8),
+        actions=sds((T, B), jnp.int32),
+        rewards=sds((T, B), jnp.float32),
+        dones=sds((T, B), jnp.float32),
+        behavior_log_probs=sds((T, B), jnp.float32),
+        behavior_values=sds((T, B), jnp.float32),
+        bootstrap_state=sds((B, *cfg.state_shape), jnp.uint8),
+    )
+    return TraceTarget(
+        name="fused.learner",
+        jit_fn=step.learner_jit,
+        args=(train, block, _scalar(jnp.float32), _scalar(jnp.float32)),
+        grad_shapes=_grad_shapes(train.params),
+        # only the train state is donated — the block must stay live (it
+        # is the double-buffer slot the actor wrote; no learner output
+        # matches its shapes, so an alias is impossible anyway)
+        donated_nonscalar_indices=_donated_indices(train),
     )
 
 

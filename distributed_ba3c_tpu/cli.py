@@ -119,7 +119,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--pipe_c2s", default=None, help="master experience-plane bind address, e.g. tcp://0.0.0.0:5555 (default: per-pid ipc://)")
     p.add_argument("--pipe_s2c", default=None, help="master action-plane bind address, e.g. tcp://0.0.0.0:5556 (default: per-pid ipc://)")
     p.add_argument("--max_to_keep", type=int, default=3, help="checkpoints retained (besides best); raise to keep every eval-epoch checkpoint for post-hoc crossing verification")
-    p.add_argument("--steps_per_dispatch", type=int, default=1, help="fused trainer: wrap K update steps in one lax.scan program (one host dispatch per K updates; must divide --steps_per_epoch). Removes per-step dispatch overhead without relying on host pipelining")
+    p.add_argument("--steps_per_dispatch", type=int, default=1, help="fused trainer: wrap K update steps in one lax.scan program (one host dispatch per K updates; must divide --steps_per_epoch). Removes per-step dispatch overhead without relying on host pipelining. With --overlap, K actor/learner dispatch PAIRS per facade call instead")
+    p.add_argument("--overlap", action="store_true", help="fused trainer: split the single fused program into two overlapped compiled programs — rollout k+1 runs concurrently with learner k (policy lag 1, V-trace-corrected; docs/overlap.md)")
+    p.add_argument("--rollout_dtype", default="float32", choices=["float32", "bfloat16"], help="--overlap only: dtype of the actor program's params snapshot. bfloat16 halves the rollout's param-read bandwidth; the heads stay f32 and V-trace clips the precision noise")
     p.add_argument("--rank_stall_timeout", type=float, default=0, help="multi-host: seconds without proven progress (beats land after the dispatch-window metrics fetch, after eval, and after the collective save) before a rank declares a peer dead and exits 75 (0 = default 600s when multi-host; -1 disables the watchdog; the limit self-raises to 2x the slowest healthy window). Relaunch with --load to resume")
     p.add_argument("--seed", type=int, default=0, help="fused trainer: PRNG seed for params/envs/action sampling (whole-trajectory determinism per seed; multi-seed runs disclose seed selection in RESULTS.md)")
     p.add_argument("--tpu_lock", default="wait", choices=["wait", "fail", "off"], help="host-local TPU-claim mutex (utils/devicelock.py): wait = queue behind the current holder, fail = exit with the holder's pid/run, off = no guard. CPU-platform runs never take the lock")
@@ -255,6 +257,12 @@ def main(argv: Optional[list] = None) -> int:
         raise SystemExit(
             f"--steps_per_dispatch {args.steps_per_dispatch} must divide "
             f"--steps_per_epoch {args.steps_per_epoch}"
+        )
+    if args.overlap and args.trainer != "tpu_fused_ba3c":
+        raise SystemExit(
+            "--overlap splits the FUSED trainer's program in two — it "
+            "requires --trainer tpu_fused_ba3c (the ZMQ trainers already "
+            "overlap actors and learner across processes)"
         )
     if args.fleet_min or args.fleet_max:
         if args.task != "train" or args.env.startswith("zmq:"):

@@ -6,6 +6,12 @@ gradients over gRPC (SURVEY.md §3.2-3.4), this path keeps everything — env
 physics, rendering, action sampling, n-step returns, loss, psum, Adam — in a
 single jitted XLA computation per iteration. Zero host round-trips; the only
 host traffic is scalar metrics.
+
+``--overlap`` (fused/overlap.py, docs/overlap.md) splits that one program
+into two overlapped compiled programs — a collective-free actor producing
+double-buffered trajectory blocks at policy k-1, and a lag-1
+V-trace-corrected learner — so the rollout's low-occupancy forwards hide
+behind the learner instead of adding to it.
 """
 
 from distributed_ba3c_tpu.fused.loop import (
@@ -14,10 +20,20 @@ from distributed_ba3c_tpu.fused.loop import (
     make_fused_step,
     run_fused_training,
 )
+from distributed_ba3c_tpu.fused.overlap import (
+    ActorState,
+    OverlapState,
+    TrajBlock,
+    make_overlap_step,
+)
 
 __all__ = [
+    "ActorState",
     "FusedState",
+    "OverlapState",
+    "TrajBlock",
     "create_fused_state",
     "make_fused_step",
+    "make_overlap_step",
     "run_fused_training",
 ]

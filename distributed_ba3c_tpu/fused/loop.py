@@ -73,6 +73,96 @@ class FusedState(struct.PyTreeNode):
     ep_return_sum: jax.Array  # [B_global] float32 sum of completed returns per env
 
 
+def make_rollout_body(model, cfg: BA3CConfig, env, params,
+                      record_log_probs: bool = False):
+    """The per-step rollout scan body — ONE implementation shared by the
+    fused step and the overlap actor program (fused/overlap.py).
+
+    Sharing it is what makes the overlap path's lag-0 parity test a real
+    contract: both programs consume the identical key sequence and action
+    sampling math, so a frozen-params run is bit-exact across them. With
+    ``record_log_probs`` the trajectory tuple grows a fifth element —
+    log mu(a_t|s_t) of the sampled action (the V-trace behavior term);
+    without it the emitted jaxpr is unchanged from the pre-split fused
+    body (the audit manifest pins that).
+    """
+
+    def rollout_body(carry, _):
+        env_state, stack, key, ep_ret, ep_cnt, ep_sum = carry
+        B = stack.shape[0]
+        out = model.apply({"params": params}, stack)
+        key, k_act, k_env = jax.random.split(key, 3)
+        actions = jax.random.categorical(k_act, out.logits, axis=-1).astype(
+            jnp.int32
+        )
+        env_keys = jax.random.split(k_env, B)
+        env_state, obs, reward, done = jax.vmap(env.step)(
+            env_state, actions, env_keys
+        )
+        # a done frame must not leak history into the new episode: zero
+        # the carried history via a mask multiply (single fused pass —
+        # cheaper than building a zeroed copy and where-selecting)
+        keep = (~done).astype(stack.dtype)[:, None, None, None]
+        new_stack = jnp.concatenate(
+            [stack[..., 1:] * keep, obs[..., None]], axis=-1
+        )
+        # episode bookkeeping (done ⇒ env auto-restarted inside step);
+        # scores accumulate RAW rewards, the learner sees clipped ones
+        ep_ret = ep_ret + reward
+        donef = done.astype(jnp.float32)
+        ep_sum = ep_sum + ep_ret * donef
+        ep_cnt = ep_cnt + done.astype(jnp.int32)
+        ep_ret = ep_ret * (1.0 - donef)
+        r_learn = (
+            jnp.clip(reward, -cfg.reward_clip, cfg.reward_clip)
+            if cfg.reward_clip
+            else reward
+        )
+        ys = (stack, actions, r_learn, donef)
+        if record_log_probs:
+            # behavior log-prob of the SAMPLED action at the ROLLOUT
+            # policy — the mu term of the V-trace correction — plus the
+            # behavior value (the learner's value-drift-across-lag
+            # diagnostic, and it keeps the value head LIVE in the actor
+            # program so jit input pruning cannot renumber the donated
+            # leaves the T2 audit pins). The heads always emit f32
+            # (models/a3c.py), so both stay f32 even under a bf16
+            # rollout-forward snapshot.
+            lp = jnp.take_along_axis(
+                jax.nn.log_softmax(out.logits, axis=-1),
+                actions[:, None], axis=-1,
+            )[:, 0]
+            ys = ys + (lp, out.value)
+        return (env_state, new_stack, key, ep_ret, ep_cnt, ep_sum), ys
+
+    return rollout_body
+
+
+def make_put_batched(batched: "NamedSharding"):
+    """Host array (GLOBAL shape) -> array sharded on the data axis.
+
+    Multi-host: every process builds the identical global state (same
+    PRNG seed) and contributes its host-major row block — the mesh's
+    data axis is laid out host-major (parallel/distributed.py), so the
+    local rows are exactly this process's slice. Shared by the fused and
+    overlap steps so their multi-host placement cannot drift."""
+
+    def _put_batched(x):
+        n_proc = jax.process_count()
+        if n_proc == 1:
+            return jax.device_put(x, batched)
+        x = np.asarray(x)
+        B = x.shape[0]
+        assert B % n_proc == 0, (B, n_proc)
+        per = B // n_proc
+        k = jax.process_index()
+        return jax.make_array_from_process_local_data(
+            batched, x[k * per : (k + 1) * per]
+        )
+
+    return _put_batched
+
+
 def create_fused_state(
     rng: jax.Array,
     model: BA3CNet,
@@ -138,39 +228,7 @@ def make_fused_step(
         params = state.train.params
         key = state.key[0]  # this shard's scalar key
 
-        def rollout_body(carry, _):
-            env_state, stack, key, ep_ret, ep_cnt, ep_sum = carry
-            B = stack.shape[0]
-            out = model.apply({"params": params}, stack)
-            key, k_act, k_env = jax.random.split(key, 3)
-            actions = jax.random.categorical(k_act, out.logits, axis=-1).astype(
-                jnp.int32
-            )
-            env_keys = jax.random.split(k_env, B)
-            env_state, obs, reward, done = jax.vmap(env.step)(
-                env_state, actions, env_keys
-            )
-            # a done frame must not leak history into the new episode: zero
-            # the carried history via a mask multiply (single fused pass —
-            # cheaper than building a zeroed copy and where-selecting)
-            keep = (~done).astype(stack.dtype)[:, None, None, None]
-            new_stack = jnp.concatenate(
-                [stack[..., 1:] * keep, obs[..., None]], axis=-1
-            )
-            # episode bookkeeping (done ⇒ env auto-restarted inside step);
-            # scores accumulate RAW rewards, the learner sees clipped ones
-            ep_ret = ep_ret + reward
-            donef = done.astype(jnp.float32)
-            ep_sum = ep_sum + ep_ret * donef
-            ep_cnt = ep_cnt + done.astype(jnp.int32)
-            ep_ret = ep_ret * (1.0 - donef)
-            r_learn = (
-                jnp.clip(reward, -cfg.reward_clip, cfg.reward_clip)
-                if cfg.reward_clip
-                else reward
-            )
-            ys = (stack, actions, r_learn, donef)
-            return (env_state, new_stack, key, ep_ret, ep_cnt, ep_sum), ys
+        rollout_body = make_rollout_body(model, cfg, env, params)
 
         carry0 = (
             state.env_state,
@@ -345,25 +403,7 @@ def make_fused_step(
 
     replicated = NamedSharding(mesh, P())
     batched = NamedSharding(mesh, batch_spec)
-
-    def _put_batched(x):
-        """Host array (GLOBAL shape) -> array sharded on the data axis.
-
-        Multi-host: every process builds the identical global state (same
-        PRNG seed) and contributes its host-major row block — the mesh's
-        data axis is laid out host-major (parallel/distributed.py), so the
-        local rows are exactly this process's slice."""
-        n_proc = jax.process_count()
-        if n_proc == 1:
-            return jax.device_put(x, batched)
-        x = np.asarray(x)
-        B = x.shape[0]
-        assert B % n_proc == 0, (B, n_proc)
-        per = B // n_proc
-        k = jax.process_index()
-        return jax.make_array_from_process_local_data(
-            batched, x[k * per : (k + 1) * per]
-        )
+    _put_batched = make_put_batched(batched)
 
     def put(state: FusedState) -> FusedState:
         """device_put a host FusedState with the step's shardings."""
@@ -377,6 +417,17 @@ def make_fused_step(
             ep_return_sum=_put_batched(state.ep_return_sum),
         )
 
+    def reset_episode_stats(state: FusedState, n_envs: int) -> FusedState:
+        """Zero the per-env episode accumulators for the next epoch window.
+
+        A step-provided hook because the overlap step keeps these fields
+        inside its ActorState (fused/overlap.py) — the epoch loop calls the
+        hook instead of reaching into the state layout."""
+        return state.replace(
+            ep_count=_put_batched(jnp.zeros(n_envs, jnp.int32)),
+            ep_return_sum=_put_batched(jnp.zeros(n_envs, jnp.float32)),
+        )
+
     step.put = put
     step.put_batched = _put_batched
     step.replicated_sharding = replicated
@@ -384,6 +435,7 @@ def make_fused_step(
     step.mesh = mesh
     step.rollout_len = rollout_len
     step.steps_per_dispatch = steps_per_dispatch
+    step.reset_episode_stats = reset_episode_stats
     step.audit_jit = jitted  # tools/ba3caudit traces THIS program
     return step
 
@@ -517,11 +569,24 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
             f"--steps_per_dispatch {k_dispatch} must divide "
             f"--steps_per_epoch {args.steps_per_epoch}"
         )
-    step = make_fused_step(
-        model, optimizer, cfg, mesh, env, rollout_len,
-        grad_chunk_samples=args.grad_chunk_samples,
-        steps_per_dispatch=k_dispatch,
-    )
+    if getattr(args, "overlap", False):
+        # two overlapped compiled programs (rollout k+1 concurrent with
+        # learner k, lag-1 V-trace correction) instead of the single fused
+        # program — docs/overlap.md
+        from distributed_ba3c_tpu.fused.overlap import make_overlap_step
+
+        step = make_overlap_step(
+            model, optimizer, cfg, mesh, env, rollout_len,
+            grad_chunk_samples=args.grad_chunk_samples,
+            steps_per_dispatch=k_dispatch,
+            rollout_dtype=getattr(args, "rollout_dtype", "float32"),
+        )
+    else:
+        step = make_fused_step(
+            model, optimizer, cfg, mesh, env, rollout_len,
+            grad_chunk_samples=args.grad_chunk_samples,
+            steps_per_dispatch=k_dispatch,
+        )
     state = create_fused_state(
         jax.random.PRNGKey(getattr(args, "seed", 0) or 0),
         model, cfg, optimizer, env, n_envs, n_shards=n_data,
@@ -727,10 +792,9 @@ def _fused_epoch_body(
             else float("nan")
         )
         # reset the per-env episode accumulators for the next window
-        state = state.replace(
-            ep_count=step.put_batched(jnp.zeros(n_envs, jnp.int32)),
-            ep_return_sum=step.put_batched(jnp.zeros(n_envs, jnp.float32)),
-        )
+        # (step-provided hook: the fused and overlap steps keep these
+        # fields in different state layouts)
+        state = step.reset_episode_stats(state, n_envs)
         if os.environ.get("BA3C_PARAM_DIGEST"):
             # divergence detector for multi-host runs: ranks log this line
             # per epoch; any mismatch across ranks means the psum'd update
@@ -782,6 +846,11 @@ def _fused_epoch_body(
             )
         for k in ("loss", "policy_loss", "value_loss", "entropy", "grad_norm"):
             holder.add_stat(k, metrics[k])
+        for k in ("mean_rho", "value_lag_mae"):
+            # overlap-mode series (fused/overlap.py): how hard V-trace is
+            # clipping and how far the value fn moved across the lag
+            if k in metrics:
+                holder.add_stat(k, metrics[k])
         if telemetry.enabled():
             # same series the scrape endpoint serves, into stat.json/TB
             holder.add_stats(telemetry.export_scalars(roles=("learner",)))

@@ -5,11 +5,27 @@ standalone jit on big flat batches but the monolithic rollout+learner program
 schedules far worse (memory pressure → remat/spills near OOM). If
 t(rollout_jit) + t(learner_jit) << t(monolith), restructure fused/loop.py
 into two device calls per step.
+
+``--overlap`` (ISSUE 8): measure the REAL two-program overlap schedule
+(fused/overlap.py) instead of the round-1 ad-hoc split — per-program wall
+times (medians over ``--reps`` probe reps), the measured learner-hidden
+fraction of the actor, and ``learner_window_coverage`` (min(1,
+t_learner/t_actor)) — the device-free proxy gate quantity: how much of the
+actor's wall time the learner window is long enough to hide. Prints ONE
+JSON line on stdout (the repo's bench-tooling contract); diagnostics go to
+stderr. PERF.md round 9 records why realized concurrency is additionally
+backend-dependent (this jax's CPU client multiplexes every execution onto
+one shared intra-op pool).
 """
 
 from __future__ import annotations
 
+import json
+import sys
 import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import jax
 import jax.numpy as jnp
@@ -28,16 +44,80 @@ N_ENVS = 1024
 T = 20
 
 
+def profile_overlap(n_envs: int, rollout_len: int, fc_units: int,
+                    reps: int) -> dict:
+    """Probe the real overlap programs: solo/pair wall times + hiding."""
+    from distributed_ba3c_tpu.fused.overlap import make_overlap_step
+
+    cfg = BA3CConfig(num_actions=pong.num_actions, fc_units=fc_units)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    from distributed_ba3c_tpu.ops.gradproc import make_optimizer
+
+    opt = make_optimizer(cfg.learning_rate, cfg.adam_epsilon,
+                         cfg.grad_clip_norm)
+    mesh = make_mesh()
+    n_chips = len(jax.devices())
+    step = make_overlap_step(model, opt, cfg, mesh, pong,
+                             rollout_len=rollout_len)
+    state = step.put(create_fused_state(
+        jax.random.PRNGKey(0), model, cfg, opt, pong,
+        n_envs * n_chips, n_shards=n_chips,
+    ))
+    t0 = time.perf_counter()
+    state, m = step(state, cfg.entropy_beta)
+    float(m["loss"])  # compile + warmup fence
+    print(f"warmup (compile all programs): {time.perf_counter()-t0:.1f}s",
+          file=sys.stderr)
+    state, probe = step.probe_overlap(state, cfg.entropy_beta, reps=reps)
+    return {
+        "metric": "overlap_split_profile",
+        # probe carries the device-free proxy gate quantity
+        # (learner_window_coverage: the learner window is long enough to
+        # hide this fraction of the actor's wall time; realized hiding
+        # additionally needs concurrent execution queues — on-chip
+        # BENCH_r06 territory; overlap_efficiency is what THIS backend
+        # realizes)
+        **probe,
+        "n_envs": n_envs * n_chips,
+        "rollout_len": rollout_len,
+        "fc_units": fc_units,
+        "n_chips": n_chips,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
 def main():
     import argparse
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--tpu_lock", default="wait", choices=["wait", "fail", "off"])
+    ap.add_argument("--overlap", action="store_true",
+                    help="probe the real two-program overlap schedule "
+                    "(fused/overlap.py) and print one JSON line")
+    ap.add_argument("--n_envs", type=int, default=None,
+                    help="--overlap: envs per chip (default 128, the "
+                    "flagship shape; shrink for CPU proxy captures)")
+    ap.add_argument("--rollout_len", type=int, default=20)
+    ap.add_argument("--fc_units", type=int, default=None,
+                    help="--overlap: net width (default the real 512; "
+                    "shrink for CPU proxy captures)")
+    ap.add_argument("--reps", type=int, default=5,
+                    help="--overlap: probe repetitions (medians reported)")
     args = ap.parse_args()
 
     from distributed_ba3c_tpu.utils.devicelock import guard_tpu
 
     _lock = guard_tpu("profile_split", mode=args.tpu_lock)  # noqa: F841
+
+    if args.overlap:
+        row = profile_overlap(
+            n_envs=args.n_envs or 128,
+            rollout_len=args.rollout_len,
+            fc_units=args.fc_units or 512,
+            reps=args.reps,
+        )
+        print(json.dumps(row))
+        return
 
     cfg = BA3CConfig(num_actions=pong.num_actions)
     model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)

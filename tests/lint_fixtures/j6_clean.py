@@ -1,0 +1,42 @@
+"""J6 clean: the overlap facade idiom — both dispatches async, sync after."""
+import jax
+import numpy as np
+
+
+def actor_fn(params, astate):
+    return astate, astate
+
+
+def learner_fn(train, block):
+    return train, {}
+
+
+actor_jit = jax.jit(actor_fn, donate_argnums=(1,))
+learner_jit = jax.jit(learner_fn, donate_argnums=(0,))
+
+
+def overlap_loop(train, astate, block, n):
+    """The clean schedule: actor and learner enqueued back-to-back, no
+    host sync in between; the caller fetches metrics once per window."""
+    for _ in range(n):
+        astate, next_block = actor_jit(train, astate)
+        train, m = learner_jit(train, block)
+        block = next_block
+    return train, astate, block, m
+
+
+def window_fetch(train, astate, block, n):
+    for _ in range(n):
+        astate, next_block = actor_jit(train, astate)
+        train, m = learner_jit(train, block)
+        block = next_block
+    # sync AFTER both dispatches is the contract (once per window)
+    jax.block_until_ready(block)
+    return np.asarray(block)
+
+
+def actor_only_consumer(params, astate):
+    # no learner in scope: a plain actor caller may inspect its output
+    # (J1 still governs loops; J6 is about the two-program schedule)
+    astate, block = actor_jit(params, astate)
+    return jax.device_get(block)

@@ -1,0 +1,50 @@
+"""J6 flagged: host syncs on actor outputs between the two dispatches."""
+import jax
+import numpy as np
+
+
+def actor_fn(params, astate):
+    return astate, astate
+
+
+def learner_fn(train, block):
+    return train, {}
+
+
+actor_jit = jax.jit(actor_fn, donate_argnums=(1,))
+learner_jit = jax.jit(learner_fn, donate_argnums=(0,))
+
+
+def overlap_loop(train, astate, block, n):
+    for _ in range(n):
+        astate, next_block = actor_jit(train, astate)
+        jax.block_until_ready(next_block)  # J6: re-serializes the programs
+        train, m = learner_jit(train, block)
+        block = next_block
+    return train, astate, block
+
+
+def overlap_loop_device_get(train, astate, block, n):
+    for _ in range(n):
+        astate, next_block = actor_jit(train, astate)
+        host = jax.device_get(next_block)  # J6: sync between dispatches
+        print(host)
+        train, m = learner_jit(train, block)
+        block = next_block
+    return train, astate, block
+
+
+def overlap_loop_np_cast(train, astate, block):
+    astate, next_block = actor_jit(train, astate)
+    arr = np.asarray(next_block)  # J6: np cast is the same sync in a hat
+    print(arr.shape)
+    train, m = learner_jit(train, block)
+    return train, astate, next_block
+
+
+def overlap_loop_item(train, astate, block):
+    astate, next_block = actor_jit(train, astate)
+    x = next_block.item()  # J6: .item() blocks on the rollout
+    print(x)
+    train, m = learner_jit(train, block)
+    return train, astate, next_block

@@ -263,7 +263,9 @@ def test_iter_eqns_descends_into_scan():
 
 def test_registry_names():
     assert audit_mod.entry_names() == [
+        "fused.actor",
         "fused.greedy_eval",
+        "fused.learner",
         "fused.step",
         "parallel.train_step",
         "parallel.vtrace_step",

@@ -1,0 +1,370 @@
+"""Overlapped rollout/learner programs (fused/overlap.py): parity + units.
+
+The contracts this suite pins (ISSUE 8 acceptance):
+
+- lag-0 bit-exactness: the overlap split run sequentially (lag=0) with
+  frozen params consumes the identical key sequence as the fused step and
+  must produce bit-identical trajectories, frame stacks and episode
+  counters over a K-window — the shared rollout body
+  (fused/loop.py make_rollout_body) is what makes this a real contract.
+- lag-0 learning math: V-trace with behavior == target reduces to the
+  n-step-return A3C objective, so ONE live update from identical state
+  must land on the same params as the fused step up to fp reassociation.
+- lag-1 mode actually trains, donates safely across facade calls, and the
+  bf16 rollout snapshot runs.
+- the BA3C_AUDIT=1 retrace tripwire covers both new entry points (the CI
+  audit job runs this file's smoke with the env var set).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_ba3c_tpu.config import BA3CConfig
+from distributed_ba3c_tpu.envs.jaxenv import pong
+from distributed_ba3c_tpu.fused.loop import create_fused_state, make_fused_step
+from distributed_ba3c_tpu.fused.overlap import make_overlap_step
+from distributed_ba3c_tpu.models.a3c import BA3CNet
+from distributed_ba3c_tpu.ops.gradproc import make_optimizer
+from distributed_ba3c_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def parts():
+    cfg = BA3CConfig(num_actions=pong.num_actions, fc_units=16)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    opt = make_optimizer(cfg.learning_rate, cfg.adam_epsilon, cfg.grad_clip_norm)
+    mesh = make_mesh()
+    return cfg, model, opt, mesh
+
+
+@pytest.fixture(scope="module")
+def overlap_setup(parts):
+    cfg, model, opt, mesh = parts
+    n_data = mesh.shape["data"]
+    n_envs = 2 * n_data
+    step = make_overlap_step(model, opt, cfg, mesh, pong, rollout_len=3)
+
+    def make_state(s=step):
+        return s.put(
+            create_fused_state(
+                jax.random.PRNGKey(0), model, cfg, opt, pong, n_envs,
+                n_shards=n_data,
+            )
+        )
+
+    return cfg, step, make_state, n_envs
+
+
+def test_overlap_step_advances_and_is_finite(overlap_setup):
+    cfg, step, make_state, n_envs = overlap_setup
+    state = make_state()
+    state, metrics = step(state, cfg.entropy_beta)
+    state, metrics = step(state, cfg.entropy_beta)
+    assert int(state.train.step) == 2
+    for k, v in metrics.items():
+        assert np.isfinite(float(v)), k
+    # the overlap-specific series exist
+    assert "mean_rho" in metrics and "value_lag_mae" in metrics
+    # lag-1: a block is in flight between facade calls
+    assert state.block is not None
+    assert state.actor.obs_stack.shape == (n_envs, 84, 84, cfg.frame_history)
+
+
+def test_overlap_lag1_trains(overlap_setup):
+    cfg, step, make_state, _ = overlap_setup
+    state = make_state()
+    p0 = np.asarray(jax.tree_util.tree_leaves(state.train.params)[0]).copy()
+    state, _ = step(state, cfg.entropy_beta, learning_rate=0.0)
+    p1 = np.asarray(jax.tree_util.tree_leaves(state.train.params)[0])
+    np.testing.assert_array_equal(p0, p1)
+    state, _ = step(state, cfg.entropy_beta, learning_rate=1e-3)
+    p2 = np.asarray(jax.tree_util.tree_leaves(state.train.params)[0])
+    assert not np.allclose(p1, p2)
+
+
+def test_lag0_bitexact_with_fused_one_window(parts, overlap_setup):
+    """The acceptance parity: a lag-0 overlap run with frozen params is
+    BIT-EXACT with the fused step over one K-window (K sequential
+    iterations here) — same trajectories, frame stacks, env states and
+    episode counters. (With a live lr, bit-equality across
+    differently-compiled programs is not a sound contract — the fused
+    scanned-dispatch parity test documents why; the learning-math
+    equivalence at live lr is pinned separately below.)"""
+    cfg, model, opt, mesh = parts
+    _, _, _, n_envs = overlap_setup
+    n_data = mesh.shape["data"]
+    K = 4
+    fstep = make_fused_step(model, opt, cfg, mesh, pong, rollout_len=3)
+    ostep = make_overlap_step(model, opt, cfg, mesh, pong, rollout_len=3,
+                              lag=0)
+
+    def fresh(putter):
+        return putter(
+            create_fused_state(
+                jax.random.PRNGKey(0), model, cfg, opt, pong, n_envs,
+                n_shards=n_data,
+            )
+        )
+
+    f = fresh(fstep.put)
+    o = fresh(ostep.put)
+    for _ in range(K):
+        f, mf = fstep(f, cfg.entropy_beta, learning_rate=0.0)
+        o, mo = ostep(o, cfg.entropy_beta, learning_rate=0.0)
+    assert int(f.train.step) == int(o.train.step) == K
+    np.testing.assert_array_equal(
+        np.asarray(f.obs_stack), np.asarray(o.actor.obs_stack)
+    )
+    for fl, ol in zip(
+        jax.tree_util.tree_leaves(f.env_state),
+        jax.tree_util.tree_leaves(o.actor.env_state),
+    ):
+        np.testing.assert_array_equal(np.asarray(fl), np.asarray(ol))
+    np.testing.assert_array_equal(
+        np.asarray(f.ep_count), np.asarray(o.actor.ep_count)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(f.ep_return), np.asarray(o.actor.ep_return)
+    )
+    assert float(mf["episodes"]) == float(mo["episodes"])
+    assert float(mf["episode_return_sum"]) == float(mo["episode_return_sum"])
+
+
+def test_lag0_learner_update_matches_fused_math(parts, overlap_setup):
+    """The learning-math half of the parity gate: at lag 0 the V-trace
+    correction is the identity (rho == c == 1 up to fp noise), its value
+    targets reduce to the n-step returns, and the overlap learner's loss
+    mirrors ops/loss.py — so ONE live update from identical state must
+    produce the same params as the fused step up to float reassociation
+    (different program structure ⇒ different fusion ⇒ small ulp drift,
+    hence allclose, not array_equal)."""
+    cfg, model, opt, mesh = parts
+    _, _, _, n_envs = overlap_setup
+    n_data = mesh.shape["data"]
+    fstep = make_fused_step(model, opt, cfg, mesh, pong, rollout_len=3)
+    ostep = make_overlap_step(model, opt, cfg, mesh, pong, rollout_len=3,
+                              lag=0)
+
+    def fresh(putter):
+        return putter(
+            create_fused_state(
+                jax.random.PRNGKey(0), model, cfg, opt, pong, n_envs,
+                n_shards=n_data,
+            )
+        )
+
+    f, mf = fstep(fresh(fstep.put), cfg.entropy_beta)
+    o, mo = ostep(fresh(ostep.put), cfg.entropy_beta)
+    # identical trajectory (params were identical for the one rollout) —
+    # so the updates optimized the same batch
+    assert abs(float(mo["mean_rho"]) - 1.0) < 1e-5
+    for fl, ol in zip(
+        jax.tree_util.tree_leaves(f.train.params),
+        jax.tree_util.tree_leaves(o.train.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(fl), np.asarray(ol), rtol=2e-4, atol=2e-5
+        )
+    for k in ("loss", "policy_loss", "value_loss", "entropy"):
+        assert abs(float(mf[k]) - float(mo[k])) < 5e-4, k
+
+
+def test_overlap_steps_per_dispatch_pairs(parts):
+    cfg, model, opt, mesh = parts
+    n_data = mesh.shape["data"]
+    n_envs = 2 * n_data
+    step = make_overlap_step(model, opt, cfg, mesh, pong, rollout_len=3,
+                             steps_per_dispatch=3)
+    state = step.put(
+        create_fused_state(
+            jax.random.PRNGKey(0), model, cfg, opt, pong, n_envs,
+            n_shards=n_data,
+        )
+    )
+    state, metrics = step(state, cfg.entropy_beta)
+    assert int(state.train.step) == 3
+    for k, v in metrics.items():
+        assert np.isfinite(float(v)), k
+
+
+def test_overlap_learner_env_column_chunking(parts):
+    """Chunked gradient accumulation over env columns: (a) a
+    grad_chunk_samples smaller than one env column's T samples must CLAMP
+    to per-column chunks instead of spinning forever hunting a divisor of
+    B above B (the rounding loop walks upward — regression for the
+    unbounded-loop bug), and (b) mean-of-column-chunk grads equals the
+    full-batch gradient, so one update lands on the same params."""
+    cfg, model, opt, mesh = parts
+    n_data = mesh.shape["data"]
+    n_envs = 2 * n_data
+
+    def one_update(gcs):
+        step = make_overlap_step(model, opt, cfg, mesh, pong, rollout_len=3,
+                                 lag=0, grad_chunk_samples=gcs)
+        state = step.put(
+            create_fused_state(
+                jax.random.PRNGKey(0), model, cfg, opt, pong, n_envs,
+                n_shards=n_data,
+            )
+        )
+        state, m = step(state, cfg.entropy_beta)
+        return state.train.params, m
+
+    # per-shard T*B = 6, B = 2: gcs=2 makes ceil(6/2)=3 > B — the clamp
+    # case; gcs large = the single-chunk reference
+    p_ref, m_ref = one_update(4096)
+    p_chunk, m_chunk = one_update(2)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(p_ref), jax.tree_util.tree_leaves(p_chunk)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+        )
+    assert abs(float(m_ref["loss"]) - float(m_chunk["loss"])) < 5e-4
+
+
+def test_overlap_bf16_rollout_runs(parts):
+    """The bf16 params-snapshot actor: runs, stays finite, and the learner
+    (whose target forward stays f32-param) still trains on its blocks."""
+    cfg, model, opt, mesh = parts
+    n_data = mesh.shape["data"]
+    n_envs = 2 * n_data
+    step = make_overlap_step(model, opt, cfg, mesh, pong, rollout_len=3,
+                             rollout_dtype="bfloat16")
+    state = step.put(
+        create_fused_state(
+            jax.random.PRNGKey(0), model, cfg, opt, pong, n_envs,
+            n_shards=n_data,
+        )
+    )
+    p0 = np.asarray(jax.tree_util.tree_leaves(state.train.params)[0]).copy()
+    state, metrics = step(state, cfg.entropy_beta)
+    for k, v in metrics.items():
+        assert np.isfinite(float(v)), k
+    assert np.all(np.isfinite(np.asarray(state.block.behavior_log_probs)))
+    p1 = np.asarray(jax.tree_util.tree_leaves(state.train.params)[0])
+    assert not np.array_equal(p0, p1)
+
+
+def test_overlap_reset_episode_stats_hook(overlap_setup):
+    cfg, step, make_state, n_envs = overlap_setup
+    state = make_state()
+    for _ in range(6):
+        state, metrics = step(state, cfg.entropy_beta)
+    state = step.reset_episode_stats(state, n_envs)
+    assert int(np.sum(np.asarray(state.actor.ep_count))) == 0
+    assert float(np.sum(np.asarray(state.actor.ep_return_sum))) == 0.0
+    # the running (uncompleted) episode return is NOT reset — same
+    # contract as the fused epoch loop
+    state, metrics = step(state, cfg.entropy_beta)
+    for k, v in metrics.items():
+        assert np.isfinite(float(v)), k
+
+
+def test_overlap_probe_reports_and_advances(overlap_setup):
+    """probe_overlap: the sanctioned measurement site returns the solo and
+    pair wall times, publishes the telemetry gauges, and ADVANCES the
+    state (no experience replay)."""
+    from distributed_ba3c_tpu import telemetry
+
+    cfg, step, make_state, _ = overlap_setup
+    state = make_state()
+    state, _ = step(state, cfg.entropy_beta)
+    step0 = int(state.train.step)
+    state, probe = step.probe_overlap(state, cfg.entropy_beta, reps=2)
+    assert int(state.train.step) > step0
+    for k in ("actor_ms", "learner_ms", "pair_ms", "overlap_efficiency"):
+        assert k in probe
+    assert probe["actor_ms"] > 0 and probe["learner_ms"] > 0
+    scalars = telemetry.registry("learner").scalars()
+    for series in ("actor_program_ms", "learner_program_ms",
+                   "overlap_pair_ms", "overlap_efficiency"):
+        assert series in scalars, series
+
+
+def test_audit_tripwire_covers_both_programs(parts, monkeypatch):
+    """BA3C_AUDIT=1 smoke of the two new entry points (the CI audit job
+    runs exactly this test): both programs get a RetraceTripwire, warm up
+    in one trace each, arm, and a steady-state run raises nothing."""
+    monkeypatch.setenv("BA3C_AUDIT", "1")
+    from distributed_ba3c_tpu import audit
+
+    cfg, model, opt, mesh = parts
+    n_data = mesh.shape["data"]
+    n_envs = 2 * n_data
+    step = make_overlap_step(model, opt, cfg, mesh, pong, rollout_len=2)
+    state = step.put(
+        create_fused_state(
+            jax.random.PRNGKey(1), model, cfg, opt, pong, n_envs,
+            n_shards=n_data,
+        )
+    )
+    for _ in range(3):
+        state, metrics = step(state, cfg.entropy_beta)
+    float(metrics["loss"])
+    live = audit.live_tripwires()
+    for name in ("fused.actor", "fused.learner"):
+        assert name in live, name
+        assert live[name].armed
+        assert live[name].traces == 1, (name, live[name].traces)
+
+
+def test_overlap_registry_entries_trace_clean():
+    """The registry builders for the two new entries produce programs the
+    T1-T4 rules accept (T5 manifest comparison is owned by
+    test_ba3caudit's registry e2e)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the 2-device canonical mesh")
+    from distributed_ba3c_tpu import audit
+    from tools.ba3caudit import rules
+
+    for name in ("fused.actor", "fused.learner"):
+        target = audit.build_entry(name)
+        m = rules.measure(target)
+        findings = (
+            rules.check_t1(target, m) + rules.check_t2(target, m)
+            + rules.check_t3(target, m) + rules.check_t4(target, m)
+        )
+        assert findings == [], findings
+    # the actor really is collective-free — the schedule premise
+    m = rules.measure(audit.build_entry("fused.actor"))
+    assert m.collectives == {}
+
+
+def test_overlap_cli_e2e_trains_and_resumes(tmp_path):
+    """The whole driver path under --overlap: epoch loop (metrics fetch,
+    reset hook, checkpoint save) runs, and a second invocation resumes
+    from the finalized checkpoint — the overlap facade is state-compatible
+    with the fused trainer's checkpoints."""
+    import json
+
+    from distributed_ba3c_tpu.cli import main
+
+    args = [
+        "--trainer", "tpu_fused_ba3c", "--env", "jax:pong", "--overlap",
+        "--fc_units", "16", "--batch_size", "8", "--rollout_len", "4",
+        "--steps_per_epoch", "4", "--eval_every", "5",
+    ]
+    rc = main(args + ["--max_epoch", "1", "--logdir", str(tmp_path / "a")])
+    assert rc == 0
+    stats = json.load(open(tmp_path / "a" / "stat.json"))
+    assert stats[-1]["global_step"] == 4
+    assert np.isfinite(stats[-1]["loss"])
+    rc = main(args + [
+        "--max_epoch", "2", "--logdir", str(tmp_path / "b"),
+        "--load", str(tmp_path / "a" / "checkpoints"),
+    ])
+    assert rc == 0
+    stats = json.load(open(tmp_path / "b" / "stat.json"))
+    assert stats[-1]["global_step"] == 8
+
+
+def test_overlap_cli_flag_validation():
+    """--overlap outside the fused trainer is a usage error, not a
+    mystery crash later."""
+    from distributed_ba3c_tpu.cli import main
+
+    with pytest.raises(SystemExit):
+        main(["--overlap", "--trainer", "tpu_sync_ba3c", "--env", "fake"])

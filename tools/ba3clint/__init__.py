@@ -9,7 +9,8 @@ Two rule families (catalog: docs/static_analysis.md):
 * **J-series** — JAX hot-path hazards: host syncs in step loops or jitted
   functions (J1), ``jax.jit`` built inside a loop (J2), non-static literal
   args to jitted callables (J3), PRNGKey reuse without ``split`` (J4),
-  reading a donated buffer after the call (J5).
+  reading a donated buffer after the call (J5), host syncs on actor-program
+  outputs between the overlap schedule's two dispatches (J6).
 * **A-series** — actor-plane and API-hygiene conventions: bare threads (A1),
   blocking queue ops without timeouts (A2), cross-thread client-state
   mutation from closures (A3), wall-clock timeout arithmetic (A4),

@@ -351,10 +351,136 @@ class ReadAfterDonateRule(Rule):
         return None
 
 
+_J6_SYNC_ATTRS = {"block_until_ready", "item"}
+_J6_CAST_NAMES = {"float", "int", "bool"}
+
+
+class OverlapSyncHazardRule(Rule):
+    """J6: host sync on actor-program outputs between the two dispatches.
+
+    The overlap schedule (fused/overlap.py, docs/overlap.md) exists so the
+    runtime can execute rollout k+1 concurrently with learner k. A
+    ``block_until_ready``/``device_get``/``.item()``/``np.asarray``/
+    ``float()`` on the ACTOR program's outputs after the actor dispatch and
+    before the learner dispatch forces the rollout to complete before the
+    learner is even enqueued — it re-serializes the two programs and
+    silently refutes the whole split, while every test stays green.
+
+    Heuristic, tuned to the repo idiom: inside a function that calls both
+    an actor-named callable (last dotted segment contains ``actor``) and a
+    learner-named one (contains ``learner``), any sync-consuming use of a
+    name bound from the actor call, positioned after that actor call and
+    before a later learner call, is flagged. The one sanctioned site is
+    the measurement probe (``probe_overlap``), which exists to measure the
+    serialization this rule forbids — its suppressions carry the
+    justification.
+    """
+
+    id = "J6"
+    name = "overlap-sync-hazard"
+    summary = "host sync on actor-program outputs between the actor and learner dispatches"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield from self._check_fn(ctx, fn)
+
+    @staticmethod
+    def _last_segment(call: ast.Call) -> str:
+        nm = dotted_name(call.func)
+        return nm.rsplit(".", 1)[-1].lower() if nm else ""
+
+    def _check_fn(self, ctx: FileContext, fn: ast.AST) -> Iterator[Finding]:
+        actor_calls: List[ast.Call] = []
+        learner_lines: List[int] = []
+        # nested defs get their own _check_fn pass — only look at calls
+        # whose innermost enclosing function is THIS one
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            encl = enclosing_functions(node)
+            if not encl or encl[0] is not fn:
+                continue
+            seg = self._last_segment(node)
+            if "actor" in seg:
+                actor_calls.append(node)
+            elif "learner" in seg:
+                learner_lines.append(node.lineno)
+        if not actor_calls or not learner_lines:
+            return
+
+        # names bound from an actor call (tuple unpack included)
+        actor_outputs: Dict[str, int] = {}  # name -> actor call line
+        for call in actor_calls:
+            stmt = enclosing_statement(call)
+            if not isinstance(stmt, ast.Assign) or stmt.value is not call:
+                continue
+            for t in stmt.targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for el in elts:
+                    if isinstance(el, ast.Name):
+                        actor_outputs[el.id] = call.lineno
+        if not actor_outputs:
+            return
+        last_learner = max(learner_lines)
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            encl = enclosing_functions(node)
+            if not encl or encl[0] is not fn:
+                continue
+            hit = self._synced_actor_output(ctx, node, actor_outputs)
+            if hit is None:
+                continue
+            name, actor_line = hit
+            # "between the two dispatches": after the actor call that
+            # bound the name, before the last learner dispatch
+            if actor_line < node.lineno <= last_learner:
+                yield ctx.finding(
+                    self, node,
+                    f"host sync on actor-program output `{name}` between "
+                    "the actor and learner dispatches — this forces the "
+                    "rollout to finish before the learner is enqueued, "
+                    "re-serializing the overlapped programs; sync after "
+                    "both dispatches (or once per window)",
+                )
+
+    @staticmethod
+    def _synced_actor_output(
+        ctx: FileContext, call: ast.Call, actor_outputs: Dict[str, int]
+    ) -> Optional[Tuple[str, int]]:
+        """(name, actor line) if ``call`` host-syncs an actor output."""
+
+        def names_in(expr: ast.AST) -> Iterator[str]:
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Name):
+                    yield sub.id
+
+        f = call.func
+        resolved = ctx.info.resolve(f)
+        is_sync_fn = resolved in _SYNC_FNS or resolved in _HOST_CAST_FNS or (
+            isinstance(f, ast.Name) and f.id in _J6_CAST_NAMES
+        )
+        if is_sync_fn:
+            for arg in call.args:
+                for nm in names_in(arg):
+                    if nm in actor_outputs:
+                        return nm, actor_outputs[nm]
+            return None
+        if isinstance(f, ast.Attribute) and f.attr in _J6_SYNC_ATTRS:
+            for nm in names_in(f.value):
+                if nm in actor_outputs:
+                    return nm, actor_outputs[nm]
+        return None
+
+
 JAX_RULES = [
     HostSyncHotPathRule(),
     JitInLoopRule(),
     NonStaticJitArgRule(),
     PRNGKeyReuseRule(),
     ReadAfterDonateRule(),
+    OverlapSyncHazardRule(),
 ]
