@@ -75,7 +75,12 @@ class BA3CSimulatorMaster(SimulatorMaster):
             )
             self.send_action(ident, action)
 
-        self.predictor.put_task(state, cb)
+        # shed fallback (docs/serving.md): under an SLO'd predictor a shed
+        # task answers with a uniform-random action instead of wedging the
+        # simulator; without deadlines (the default) it never fires
+        self.predictor.put_task(
+            state, cb, shed_callback=self._shed_fallback_row(cb)
+        )
 
     def _on_episode_over(self, ident: bytes) -> None:
         client = self.clients[ident]
@@ -126,7 +131,12 @@ class BA3CSimulatorMaster(SimulatorMaster):
             )
             self.send_block_actions(ident, actions)
 
-        self.predictor.put_block_task(states, cb)
+        # same fallback contract as the per-env path: a shed block gets
+        # uniform-random actions so the lockstep server never wedges
+        self.predictor.put_block_task(
+            states, cb,
+            shed_callback=self._shed_fallback_block(cb, len(states)),
+        )
 
     def _on_block_flush(self, ident: bytes) -> None:
         """Per-env n-step emission over the block's shared step list.

@@ -346,6 +346,16 @@ class SimulatorMaster(threading.Thread):
         self._c_blocked_puts = tele.counter("queue_blocked_puts_total")
         self._h_put_wait = tele.histogram("queue_put_wait_s", unit=1e-6)
         self._h_ingest = tele.histogram("e2e_ingest_latency_s", unit=1e-6)
+        # SLO-serving fallback accounting (docs/serving.md): rows answered
+        # with the uniform-random fallback after the predictor shed the
+        # task (deadline/queue_full typed reject)
+        self._c_shed_fallbacks = tele.counter("predictor_shed_fallbacks_total")
+        # uniform-fallback RNG for shed replies; sheds can be delivered
+        # from the admitting thread AND the predictor scheduler thread, and
+        # numpy Generators are not thread-safe — same locking convention as
+        # the predictor's PRNG key
+        self._shed_rng = np.random.default_rng(0)
+        self._shed_lock = threading.Lock()
         ref = weakref.ref(self)
         tele.gauge(
             "clients", fn=lambda: len(m.clients) if (m := ref()) else 0
@@ -767,6 +777,48 @@ class SimulatorMaster(threading.Thread):
         if m:
             del blk.steps[:m]
             blk.start -= m
+
+    # -- serving-plane shed fallbacks (docs/serving.md) --------------------
+    def _shed_fallback_block(self, cb, k: int):
+        """Fallback reply for a shed block task (predict/server.py's typed
+        :class:`ShedReject`): answer with uniform-random actions so the
+        lockstep server keeps stepping instead of parking in ``recv()``.
+        The recorded behavior log-prob IS correct for the fallback policy
+        (log 1/A), so V-trace stays exact and BA3C merely learns from a
+        few exploratory steps; value 0 is the honest no-estimate."""
+
+        def shed(reject):
+            A = int(getattr(self.predictor, "num_actions", 0) or 0)
+            if A <= 0:
+                # no known action space to fall back to: leave the server
+                # to the prune path and the operator to the flight record
+                self._flight.record("shed_no_fallback", reason=reject.reason)
+                return
+            with self._shed_lock:
+                acts = self._shed_rng.integers(0, A, k)
+            self._c_shed_fallbacks.inc(k)
+            cb(
+                np.ascontiguousarray(acts, np.int32),
+                np.zeros(k, np.float32),
+                np.full(k, -np.log(A), np.float32),
+            )
+
+        return shed
+
+    def _shed_fallback_row(self, cb):
+        """Per-env-wire analogue of :meth:`_shed_fallback_block`."""
+
+        def shed(reject):
+            A = int(getattr(self.predictor, "num_actions", 0) or 0)
+            if A <= 0:
+                self._flight.record("shed_no_fallback", reason=reject.reason)
+                return
+            with self._shed_lock:
+                a = int(self._shed_rng.integers(0, A))
+            self._c_shed_fallbacks.inc()
+            cb(a, 0.0, float(-np.log(A)))
+
+        return shed
 
     def send_action(self, ident: bytes, action: int) -> None:
         self._put_stoppable(self.send_queue, [ident, dumps(int(action))])

@@ -93,7 +93,11 @@ class VTraceSimulatorMaster(SimulatorMaster):
             client.memory.append(_Step(state, action, logp))  # ba3clint: disable=A3
             self.send_action(ident, action)
 
-        self.predictor.put_task(state, cb)
+        # shed fallback (docs/serving.md): the uniform logp the fallback
+        # records is the TRUE behavior policy, so V-trace stays exact
+        self.predictor.put_task(
+            state, cb, shed_callback=self._shed_fallback_row(cb)
+        )
 
     def _on_datapoint(self, ident: bytes) -> None:
         pass  # segment emission happens in _on_message
@@ -165,7 +169,10 @@ class VTraceSimulatorMaster(SimulatorMaster):
             )
             self.send_block_actions(ident, actions)
 
-        self.predictor.put_block_task(states, cb)
+        self.predictor.put_block_task(
+            states, cb,
+            shed_callback=self._shed_fallback_block(cb, len(states)),
+        )
 
     def _on_block_flush(self, ident: bytes) -> None:
         """Per-env unroll emission (block analogue of :meth:`_maybe_emit`).

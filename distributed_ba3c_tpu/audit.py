@@ -23,6 +23,8 @@ The registered entry points (one per hot-path jit site):
     fused.learner         the overlap V-trace learner (fused/overlap.py)
     fused.greedy_eval     the on-device greedy Evaluator (fused/loop.py)
     predict.server        the batched action-server forward (predict/server.py)
+    predict.server_greedy the greedy (eval/play) server variant — [3, B]
+                          packed fetch (the duplicated argmax row dropped)
 
 Canonical shapes are deliberately SMALL (the invariants are shape-class
 properties, not magnitude properties) and the canonical mesh is always the
@@ -536,5 +538,30 @@ def _build_predict_server() -> TraceTarget:
         donated_nonscalar_indices=[],
         # single-device serving path: any collective here means a mesh
         # sharding leaked into the action server
+        allow_collectives=False,
+    )
+
+
+@register_entry("predict.server_greedy")
+def _build_predict_server_greedy() -> TraceTarget:
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ba3c_tpu.predict.server import make_fwd_sample
+
+    cfg, model, opt = _canonical_parts()
+    params = _state_avals(model, cfg, opt).params
+    B = 16  # same canonical bucket as predict.server
+    states = jax.ShapeDtypeStruct((B, *cfg.state_shape), jnp.uint8)
+    return TraceTarget(
+        name="predict.server_greedy",
+        # the eval/play servers' program: greedy=True drops the duplicated
+        # argmax row, shrinking the packed fetch to [3, B] — registering
+        # BOTH shapes keeps T5 pinned on each (the sampling entry must not
+        # silently absorb the greedy server's cost profile)
+        jit_fn=jax.jit(make_fwd_sample(model, greedy=True)),
+        args=(params, states, _key_aval()),
+        grad_shapes=None,
+        donated_nonscalar_indices=[],
         allow_collectives=False,
     )

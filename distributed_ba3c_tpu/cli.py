@@ -114,6 +114,33 @@ def make_parser() -> argparse.ArgumentParser:
         "--autoscale_interval", type=float, default=2.0,
         help="seconds between autoscaler policy ticks",
     )
+    # -- SLO-aware serving plane (docs/serving.md) -------------------------
+    p.add_argument(
+        "--serve_slo_ms", type=float, default=0.0,
+        help="predictor serving deadline budget in ms (0 = off). Every "
+        "queued predict task gets deadline = admit + slo; tasks the "
+        "scheduler proves can't make it are SHED with a typed reject "
+        "(masters fall back to a uniform-random action) and a full "
+        "admission queue rejects fast instead of queueing unboundedly",
+    )
+    p.add_argument(
+        "--canary_load", default=None,
+        help="checkpoint dir served as the 'canary' policy on "
+        "--canary_fraction of live predict traffic (multi-policy serving; "
+        "per-policy rows on the telemetry endpoint)",
+    )
+    p.add_argument(
+        "--canary_fraction", type=float, default=0.0,
+        help="fraction of predict traffic routed to --canary_load "
+        "(deterministic group-granular deficit split, no RNG, batch "
+        "occupancy preserved)",
+    )
+    p.add_argument(
+        "--shadow_load", default=None,
+        help="checkpoint dir served as the 'shadow' policy: mirrors EVERY "
+        "served batch, results dropped before any caller — pure "
+        "observability (tele/predictor/shadow_* series)",
+    )
     p.add_argument("--profiler_port", type=int, default=0, help="start jax.profiler server on this port (0=off)")
     p.add_argument("--telemetry_port", type=int, default=0, help="serve the telemetry scrape endpoint on this port (0=off): /metrics Prometheus text, /json raw snapshots, /flight the live flight-recorder ring (docs/observability.md)")
     p.add_argument("--pipe_c2s", default=None, help="master experience-plane bind address, e.g. tcp://0.0.0.0:5555 (default: per-pid ipc://)")
@@ -263,6 +290,25 @@ def main(argv: Optional[list] = None) -> int:
             "--overlap splits the FUSED trainer's program in two — it "
             "requires --trainer tpu_fused_ba3c (the ZMQ trainers already "
             "overlap actors and learner across processes)"
+        )
+    # serving-plane flags belong to the predictor path; a fused run has no
+    # predictor, and a half-specified canary is a config typo — usage
+    # errors, never silently-ignored modifiers (repo convention)
+    serving_flags = args.serve_slo_ms or args.canary_load or args.shadow_load
+    if serving_flags and (
+        args.task != "train" or args.trainer == "tpu_fused_ba3c"
+    ):
+        raise SystemExit(
+            "--serve_slo_ms/--canary_load/--shadow_load configure the "
+            "BatchedPredictor serving plane — they apply to the ZMQ-plane "
+            "trainers' train task only (the fused trainer serves actions "
+            "inside its compiled program; eval/play are synchronous)"
+        )
+    if bool(args.canary_load) != bool(args.canary_fraction > 0):
+        raise SystemExit(
+            "--canary_load and --canary_fraction come together: the "
+            "checkpoint names WHAT to canary, the fraction names HOW MUCH "
+            "traffic it gets"
         )
     if args.fleet_min or args.fleet_max:
         if args.task != "train" or args.env.startswith("zmq:"):
@@ -426,7 +472,25 @@ def main(argv: Optional[list] = None) -> int:
         state.params,
         batch_size=cfg.predict_batch_size,
         num_threads=cfg.predictor_threads,
+        slo_ms=args.serve_slo_ms,
     )
+    # multi-policy serving (docs/serving.md): canary/shadow checkpoints
+    # are pinned policies behind the same scheduler — the learner's
+    # update_params publishes only touch 'default'
+    if args.canary_load or args.shadow_load:
+        from distributed_ba3c_tpu.train.checkpoint import CheckpointManager
+
+        def _policy_params(ckpt_dir):
+            return CheckpointManager(ckpt_dir).restore(
+                jax.device_get(state)
+            ).params
+
+        if args.canary_load:
+            predictor.add_policy("canary", _policy_params(args.canary_load))
+            predictor.set_canary("canary", args.canary_fraction)
+        if args.shadow_load:
+            predictor.add_policy("shadow", _policy_params(args.shadow_load))
+            predictor.set_shadow("shadow")
     # precompile every serving bucket now — a first-time bucket compile
     # mid-training stalls the whole actor plane for tens of seconds
     predictor.warmup(cfg.state_shape)

@@ -1,11 +1,13 @@
-"""Inference serving: the batched on-device action server.
+"""Inference serving: the SLO-aware batched on-device action server.
 
 Reference equivalent: ``tensorpack/predict/{concurrency,common,base}.py`` —
 ``MultiThreadAsyncPredictor`` et al. (SURVEY.md §2.3 #10, call stack §3.3).
-The N-thread, N-``Session.run`` design collapses into one jitted forward +
-on-device categorical sampling; host threads only batch and dispatch.
+The N-thread, N-``Session.run`` design collapses into one continuous-
+batching scheduler over a jitted forward + on-device categorical sampling:
+dispatch-depth-pipelined device calls, deadline admission with typed load
+shedding, and multi-policy (canary/shadow) serving — docs/serving.md.
 """
 
-from distributed_ba3c_tpu.predict.server import BatchedPredictor
+from distributed_ba3c_tpu.predict.server import BatchedPredictor, ShedReject
 
-__all__ = ["BatchedPredictor"]
+__all__ = ["BatchedPredictor", "ShedReject"]

@@ -1,27 +1,47 @@
-"""BatchedPredictor: micro-batching action server on one jitted device call.
+"""SLO-aware serving plane: continuous batching, deadline admission, N policies.
 
 Reference equivalent (SURVEY.md §3.3): ``MultiThreadAsyncPredictor`` /
 ``PredictorWorkerThread`` — N threads each draining a shared queue into a
-``sess.run`` on a predict tower. TPU-native redesign per BASELINE.json:
+``sess.run`` on a predict tower, best-effort, no latency contract. The
+TPU-native redesign (BASELINE.json + ROADMAP item 2, docs/serving.md):
 
-- ONE compiled function: forward + categorical sample, executed on device;
-  action sampling never returns logits to the host (A ints instead of A
-  floats per sim cross the device boundary).
-- Batch shapes are bucketed to powers of two and padded, so XLA compiles a
-  handful of programs once instead of one per queue length.
-- Weights live in device HBM; the learner publishes fresh params with
-  ``update_params`` (an atomic Python ref swap — the reference's predict
-  towers read shared TF variables the same way).
+- ONE compiled function per policy: forward + categorical sample on device;
+  action sampling never returns logits to the host. Batch shapes are padded
+  to warmed pow-2 buckets so XLA compiles a handful of programs once.
+- **Continuous batching**: a single scheduler thread keeps up to
+  ``dispatch_depth`` device calls in flight and admits freshly queued tasks
+  into the NEXT bucket the moment the current one is dispatched — the fetch
+  of call k happens only after call k+1 is enqueued (the overlap lesson,
+  docs/overlap.md: the host must never sync between dispatches), so the
+  device never idles between micro-batches. The in-flight call IS the
+  coalesce window; the ``coalesce_ms`` timer only applies when the device
+  is idle.
+- **Deadline admission + load shedding**: every task can carry a deadline
+  (defaulted from ``slo_ms``); the scheduler sheds tasks that cannot make
+  their deadline BEFORE spending device time on them, and a bounded
+  admission queue turns overload into fast typed rejection
+  (:class:`ShedReject`) instead of unbounded latency. Tasks without a
+  deadline keep the training plane's backpressure contract (blocking put).
+- **Multi-policy serving**: N checkpoints hot simultaneously behind the one
+  scheduler (``add_policy``); each task carries a policy id, a canary
+  fraction routes live traffic deterministically (``set_canary``), and a
+  shadow policy (``set_shadow``) sees every served batch with its results
+  dropped before any caller — per-policy row counters keep the evaluation
+  observable (docs/observability.md).
 
-The worker thread dispatches callbacks; with the GIL this matches the
-reference's callback-from-worker-thread semantics.
+Weights live in device HBM; the learner publishes fresh params with
+``update_params`` (an atomic Python ref swap — canary/shadow policies stay
+pinned at their own checkpoints unless explicitly republished).
 """
 
 from __future__ import annotations
 
+import collections
 import queue
+import re
 import threading
-from typing import Any, Callable, List, Optional, Tuple
+import weakref
+from typing import Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -29,14 +49,50 @@ import numpy as np
 
 from distributed_ba3c_tpu import telemetry
 from distributed_ba3c_tpu.audit import tripwire_jit
+from distributed_ba3c_tpu.utils import logger
 from distributed_ba3c_tpu.utils.concurrency import (
+    FastQueue,
     StoppableThread,
     queue_put_stoppable,
 )
 
+#: metric-name grammar for policy ids: they are embedded in Prometheus
+#: series names (``policy_<id>_rows_total``), so one junk id would poison
+#: every scrape (telemetry/exporters.py enforces the same grammar)
+_POLICY_ID_RE = re.compile(r"^[a-z0-9_]{1,32}$")
+
 
 def _next_pow2(n: int) -> int:
     return 1 << (n - 1).bit_length()
+
+
+class ShedReject:
+    """Typed reject delivered to a task's ``shed_callback``.
+
+    ``reason`` is one of:
+
+    - ``"deadline"``: the scheduler proved the task could not be served
+      before its deadline (queue wait + estimated device time) and shed it
+      WITHOUT spending device time on it;
+    - ``"queue_full"``: the bounded admission queue was full — the fast
+      overload signal; retry after backing off, or fall back;
+    - ``"shutdown"``: the predictor stopped while the task waited.
+
+    Callers decide the fallback: the actor-plane masters reply with a
+    uniform-random action (the behavior log-prob stays correct for
+    V-trace); a serving frontend would surface a 429/503 equivalent.
+    """
+
+    __slots__ = ("reason", "deadline", "now")
+
+    def __init__(self, reason: str, deadline: Optional[float] = None,
+                 now: Optional[float] = None):
+        self.reason = reason
+        self.deadline = deadline
+        self.now = now
+
+    def __repr__(self) -> str:
+        return f"ShedReject(reason={self.reason!r}, deadline={self.deadline})"
 
 
 class _BlockTask:
@@ -47,20 +103,65 @@ class _BlockTask:
     per-row Python bookkeeping anywhere between the socket and the device.
     """
 
-    __slots__ = ("states", "callback", "k")
+    __slots__ = ("states", "callback", "k", "deadline", "policy", "shed_cb",
+                 "t_admit")
 
-    def __init__(self, states, callback):
+    def __init__(self, states, callback, deadline=None, policy=None,
+                 shed_cb=None):
         self.states = states
         self.callback = callback
         self.k = states.shape[0]
+        self.deadline = deadline
+        self.policy = policy
+        self.shed_cb = shed_cb
+        self.t_admit = 0.0
+
+
+class _RowTask:
+    """One single state row (per-env wire); ``k`` is always 1."""
+
+    __slots__ = ("states", "callback", "k", "deadline", "policy", "shed_cb",
+                 "t_admit")
+
+    def __init__(self, state, callback, deadline=None, policy=None,
+                 shed_cb=None):
+        self.states = state
+        self.callback = callback
+        self.k = 1
+        self.deadline = deadline
+        self.policy = policy
+        self.shed_cb = shed_cb
+        self.t_admit = 0.0
+
+
+class _Inflight:
+    """One dispatched-not-yet-fetched device call the scheduler tracks."""
+
+    __slots__ = ("tasks", "n", "policy", "handle", "t_dispatch", "t_oldest",
+                 "shadow", "states")
+
+    def __init__(self, tasks, n, policy, handle, t_dispatch, t_oldest=0.0,
+                 shadow=False, states=None):
+        self.tasks = tasks        # ordered singles-then-blocks; None = shadow
+        self.n = n
+        self.policy = policy
+        self.handle = handle      # (k, dispatched device array)
+        self.t_dispatch = t_dispatch
+        # admit stamp of the group's FIFO-oldest task — tasks is REORDERED
+        # (singles first, matching the batch layout), so latency accounting
+        # must not read tasks[0]
+        self.t_oldest = t_oldest
+        self.shadow = shadow
+        self.states = states      # batch kept only for a shadow tap
 
 
 def make_fwd_sample(model, greedy: bool = False) -> Callable:
     """The action server's compiled program: forward + on-device sampling.
 
     Module-level (not a closure in ``__init__``) so the audit registry
-    (distributed_ba3c_tpu/audit.py, entry ``predict.server``) traces the
-    same function the live predictor jits.
+    (distributed_ba3c_tpu/audit.py, entries ``predict.server`` and
+    ``predict.server_greedy``) traces the same function the live predictor
+    jits — BOTH packed shapes are registered so T5 pins them.
     """
 
     def fwd_sample(params, states, key):
@@ -78,30 +179,40 @@ def make_fwd_sample(model, greedy: bool = False) -> Callable:
         # device readback costs ~135 ms PER ARRAY regardless of size
         # (latency, not bandwidth), so four separate fetches were 540 ms
         # per serving call — 400x the 1.3 ms compute (see PERF.md).
-        greedy_actions = jnp.argmax(out.logits, axis=-1)
-        packed = jnp.stack(
-            [
-                actions.astype(jnp.float32),
-                out.value,
-                logp,
-                greedy_actions.astype(jnp.float32),
-            ]
-        )
-        return packed  # [4, B] float32
+        rows = [actions.astype(jnp.float32), out.value, logp]
+        if not greedy:
+            # the sampling server also publishes the argmax channel (the
+            # Evaluator consumes it without a second device call); under
+            # greedy=True row 0 IS the argmax, so the duplicate row is
+            # dropped and the packed fetch shrinks to [3, B]
+            rows.append(jnp.argmax(out.logits, axis=-1).astype(jnp.float32))
+        return jnp.stack(rows)  # [3, B] greedy / [4, B] sampling, float32
 
     return fwd_sample
 
 
 class BatchedPredictor:
-    """Asynchronous batched (action, value) server.
+    """Asynchronous batched (action, value) server with an SLO contract.
 
     Parameters
     ----------
     model: a flax module with ``apply({'params': p}, states) -> PolicyValue``.
-    params: initial parameter pytree (host or device).
-    batch_size: max micro-batch (reference PREDICT_BATCH_SIZE).
-    num_threads: worker threads draining the task queue (device calls
-        serialize on the device anyway; >1 only helps overlap host work).
+    params: initial parameter pytree for the ``default`` policy.
+    batch_size: micro-batch coalesce target (reference PREDICT_BATCH_SIZE);
+        the hard bucket cap is the next power of two.
+    num_threads: kept for call-site compatibility; the continuous-batching
+        scheduler is ONE thread (dispatch order must be owned by one place
+        for the depth pipeline), and pipelined dispatch replaces the old
+        multi-worker host overlap.
+    slo_ms: default deadline budget applied to every queued task (0 = no
+        deadlines — the training plane's backpressure semantics).
+    queue_depth: admission-queue bound. With deadlines, a full queue is an
+        immediate typed reject (fast overload signal); without, a blocking
+        backpressure put as before.
+    dispatch_depth: device calls kept in flight by the scheduler (2 = the
+        continuous-batching default: fetch k only after dispatching k+1).
+    clock: monotonic-clock callable (tests inject a fake clock to make
+        shed decisions deterministic).
     """
 
     def __init__(
@@ -113,24 +224,56 @@ class BatchedPredictor:
         seed: int = 0,
         greedy: bool = False,
         coalesce_ms: float = 2.0,
+        slo_ms: float = 0.0,
+        queue_depth: int = 4096,
+        dispatch_depth: int = 2,
+        clock: Optional[Callable[[], float]] = None,
     ):
+        import time as _time
+
         self._model = model
-        self._params = jax.device_put(params)
+        self.num_actions = int(getattr(model, "num_actions", 0) or 0)
+        self._policies = {"default": jax.device_put(params)}
         self._batch_size = batch_size
         self._coalesce_s = coalesce_ms / 1000.0
-        self._queue: "queue.Queue[Tuple[np.ndarray, Callable]]" = queue.Queue(
-            maxsize=4096
-        )
+        self._slo_s = slo_ms / 1000.0
+        self._depth = max(1, int(dispatch_depth))
+        self._clock = clock or _time.monotonic
+        # bounded admission queue, deque-based (utils/concurrency.py): at
+        # serving rates a mutex+condvar queue.Queue costs a futex per op on
+        # sandboxed kernels — the same ceiling the train queue hit in PR 4
+        self._queue: FastQueue = FastQueue(maxsize=queue_depth)
         self._key = jax.random.PRNGKey(seed)
         self._key_lock = threading.Lock()
         self._greedy = greedy
         self._stop_evt = threading.Event()
+        # serve-time estimate feeding the deadline gate: a DECAYING MAX of
+        # dispatch->fetch wall time (includes pipeline wait). Deliberately
+        # conservative: the estimator's error mode must be shedding a task
+        # that would have made it, never serving one late (docs/serving.md)
+        self._est_serve_s = 0.0
+        self._inflight_n = 0
+        # multi-policy routing state: canary is an atomic (policy, fraction)
+        # tuple swap. Routing happens at GROUP granularity in the scheduler
+        # (a deficit accumulator — exactly `fraction` of routed rows over
+        # time, no RNG): per-task routing would break every group at the
+        # policy boundary and collapse batch occupancy whenever canary
+        # traffic interleaves.
+        self._canary: Optional[Tuple[str, float]] = None
+        self._shadow: Optional[str] = None
+        self._canary_debt = 0.0  # scheduler-thread only
+        self._held = None  # scheduler-local FIFO carry between groups
+        #: test/eval tap for shadow results: ``tap(states, actions, policy)``
+        #: — when None (production) shadow results are dropped WITHOUT a
+        #: host sync
+        self.shadow_tap: Optional[Callable] = None
 
         # telemetry (docs/observability.md): serving-side counters live in
         # the predictor role registry; the bucket-occupancy histogram is
         # what separates "tiny fragmented batches" from "full buckets"
         # when the plane slows down. Unit=1: occupancies are row counts.
         tele = telemetry.registry("predictor")
+        self._tele = tele
         self._c_batches = tele.counter("batches_total")
         self._c_rows = tele.counter("rows_total")
         self._c_oversize = tele.counter("blocks_oversize_total")
@@ -138,26 +281,51 @@ class BatchedPredictor:
         self._c_chunked = tele.counter("chunked_calls_total")
         self._c_chunks = tele.counter("chunks_total")
         self._h_occupancy = tele.histogram("batch_rows", unit=1)
-        import weakref
+        # SLO plane series: sheds are counted in ROWS (a shed block is k
+        # lost requests, not one), misses are rows served past their
+        # deadline (should stay ~0 — they measure the estimator's error,
+        # not the shed policy)
+        self._c_sheds = tele.counter("sheds_total")
+        self._c_shed_deadline = tele.counter("sheds_deadline_total")
+        self._c_shed_full = tele.counter("sheds_queue_full_total")
+        self._c_deadline_miss = tele.counter("deadline_misses_total")
+        self._h_queue_wait = tele.histogram("queue_wait_s", unit=1e-6)
+        self._h_serve = tele.histogram("serve_latency_s", unit=1e-6)
+        self._c_shadow_batches = tele.counter("shadow_batches_total")
+        self._c_shadow_rows = tele.counter("shadow_rows_total")
+        self._c_cb_errors = tele.counter("callback_errors_total")
+        self._c_policy_rows = {
+            "default": tele.counter("policy_default_rows_total")
+        }
 
         ref = weakref.ref(self)
         tele.gauge(
             "task_queue_depth",
             fn=lambda: p._queue.qsize() if (p := ref()) else 0,
         )
+        tele.gauge(
+            "slo_ms", fn=lambda: p._slo_s * 1000.0 if (p := ref()) else 0
+        )
+        tele.gauge(
+            "inflight_dispatches",
+            fn=lambda: p._inflight_n if (p := ref()) else 0,
+        )
 
         # registered audit entry point (distributed_ba3c_tpu/audit.py).
         # auto_arm=False: the pow-2 bucket warmup is a LEGITIMATE multi-shape
         # compile sequence; warmup() arms the tripwire when it completes, so
-        # only a new bucket size appearing mid-serving raises.
+        # only a new bucket size appearing mid-serving raises. Continuous
+        # batching keeps this contract: every group is padded to a warmed
+        # bucket before dispatch.
         self._fwd = tripwire_jit(
-            "predict.server", make_fwd_sample(model, greedy), auto_arm=False
+            "predict.server_greedy" if greedy else "predict.server",
+            make_fwd_sample(model, greedy),
+            auto_arm=False,
         )
         self.threads: List[StoppableThread] = [
             StoppableThread(
-                target=self._worker, daemon=True, name=f"predictor-{i}"
+                target=self._scheduler, daemon=True, name="predictor-sched"
             )
-            for i in range(num_threads)
         ]
 
     # -- lifecycle ---------------------------------------------------------
@@ -166,11 +334,13 @@ class BatchedPredictor:
             t.start()
 
     def warmup(self, state_shape, dtype=np.uint8) -> None:
-        """Precompile every pow-2 bucket up to batch_size.
+        """Precompile every pow-2 bucket up to batch_size, for EVERY policy.
 
         Each new bucket size triggers a fresh XLA compile (tens of seconds
         on TPU) the first time it is served; hitting that mid-training
-        stalls the whole actor plane. Call once before actors start."""
+        stalls the whole actor plane. Call once before actors start (and
+        after ``add_policy`` — same program, but the warmup proves the
+        shapes through)."""
         b = 1
         while b <= _next_pow2(self._batch_size):
             self._run_device(np.zeros((b, *state_shape), dtype))
@@ -185,38 +355,105 @@ class BatchedPredictor:
             t.stop()
 
     def join(self, timeout: Optional[float] = None) -> None:
-        """Wait for worker threads to exit (they poll with 0.5s timeout)."""
+        """Wait for the scheduler thread to exit (it polls with 0.5s
+        timeout)."""
         for t in self.threads:
             if t.is_alive():
                 t.join(timeout)
 
-    # -- API ---------------------------------------------------------------
-    def update_params(self, params) -> None:
-        """Publish fresh weights (atomic ref swap; next batch uses them)."""
-        self._params = params
+    # -- policy table ------------------------------------------------------
+    def add_policy(self, policy_id: str, params) -> None:
+        """Make a second checkpoint hot behind the same scheduler.
+
+        ``policy_id`` must match ``[a-z0-9_]{1,32}`` — it is embedded in
+        the per-policy telemetry series names."""
+        if not _POLICY_ID_RE.match(policy_id):
+            raise ValueError(
+                f"policy id {policy_id!r} must match {_POLICY_ID_RE.pattern} "
+                "(it names Prometheus series)"
+            )
+        self._policies[policy_id] = jax.device_put(params)
+        self._c_policy_rows.setdefault(
+            policy_id, self._tele.counter(f"policy_{policy_id}_rows_total")
+        )
+
+    def set_canary(self, policy_id: str, fraction: float) -> None:
+        """Route ``fraction`` of un-pinned traffic to ``policy_id``.
+
+        Deterministic deficit-accumulator split at GROUP granularity (no
+        RNG, full batch occupancy preserved): over time exactly
+        ``fraction`` of routed rows serve the canary. 0 clears the
+        canary. Callers that pin ``policy=`` on their tasks bypass
+        routing."""
+        if fraction <= 0:
+            self._canary = None
+            return
+        if not 0 < fraction <= 1:
+            raise ValueError(f"canary fraction {fraction} not in (0, 1]")
+        if policy_id not in self._policies:
+            raise KeyError(f"unknown policy {policy_id!r} — add_policy first")
+        self._canary = (policy_id, float(fraction))
+
+    def set_shadow(self, policy_id: Optional[str]) -> None:
+        """Mirror EVERY served batch through ``policy_id``.
+
+        The shadow call is dispatched right after the primary with the
+        identical padded batch; its results never reach any caller — they
+        are dropped undetched (no host sync) unless a ``shadow_tap`` is
+        installed. ``None`` clears."""
+        if policy_id is not None and policy_id not in self._policies:
+            raise KeyError(f"unknown policy {policy_id!r} — add_policy first")
+        self._shadow = policy_id
+
+    def update_params(self, params, policy: str = "default") -> None:
+        """Publish fresh weights (atomic ref swap; next batch uses them).
+
+        Only EXISTING policies can be republished — a typo'd id must fail
+        loudly, not create a dead entry while the real policy silently
+        keeps serving its stale weights."""
+        if policy not in self._policies:
+            raise KeyError(f"unknown policy {policy!r} — add_policy first")
+        self._policies[policy] = params
         self._c_publishes.inc()
 
+    # -- API ---------------------------------------------------------------
     def put_task(
-        self, state: np.ndarray, callback: Callable[[int, float, float], None]
-    ) -> None:
+        self,
+        state: np.ndarray,
+        callback: Callable[[int, float, float], None],
+        *,
+        deadline: Optional[float] = None,
+        policy: Optional[str] = None,
+        shed_callback: Optional[Callable[[ShedReject], None]] = None,
+    ) -> bool:
         """Queue one state; ``callback(action, value, logp)`` fires when
         served — logp is log mu(action|state) under the sampling policy.
-        Tasks arriving after ``stop()`` (or while stopping with a full
-        queue) are dropped — their simulators are being torn down too."""
-        queue_put_stoppable(self._queue, (state, callback), self._stop_evt)
+
+        ``deadline`` is an absolute clock() time (defaulted from ``slo_ms``
+        when set); a task that cannot make it is shed with a typed
+        :class:`ShedReject` to ``shed_callback`` instead of served late.
+        Tasks arriving after ``stop()`` are rejected the same way (their
+        simulators are being torn down too). Returns True if admitted."""
+        return self._admit(
+            _RowTask(state, callback, deadline, policy, shed_callback)
+        )
 
     def put_block_task(
         self,
         states: np.ndarray,
         callback: Callable[[np.ndarray, np.ndarray, np.ndarray], None],
-    ) -> None:
+        *,
+        deadline: Optional[float] = None,
+        policy: Optional[str] = None,
+        shed_callback: Optional[Callable[[ShedReject], None]] = None,
+    ) -> bool:
         """Queue one [B, ...] state block (the block wire's whole batch);
         ``callback(actions[B], values[B], logps[B])`` fires ONCE when the
         block is served. The block lands in a warmed pow-2 bucket as a
-        unit — no per-row splitting; when ``coalesce_ms`` allows, several
-        queued blocks share one device call (weighted coalescing in
-        :meth:`_fetch_batch`). Same drop-on-stop semantics as
-        :meth:`put_task`."""
+        unit — no per-row splitting; queued neighbors coalesce into one
+        device call up to the bucket cap (continuous batching: the
+        in-flight dispatch is the coalesce window). Same deadline/shed
+        semantics as :meth:`put_task`."""
         cap = _next_pow2(max(self._batch_size, 1))
         if states.shape[0] > cap:
             self._c_oversize.inc()
@@ -225,8 +462,8 @@ class BatchedPredictor:
                 f"bucket ({cap}) — raise predict_batch_size to at least "
                 "the env-server block size"
             )
-        queue_put_stoppable(
-            self._queue, _BlockTask(states, callback), self._stop_evt
+        return self._admit(
+            _BlockTask(states, callback, deadline, policy, shed_callback)
         )
 
     def predict_batch(
@@ -236,11 +473,116 @@ class BatchedPredictor:
 
         ``actions`` follow the serving policy (sampled, or argmax when
         ``greedy=True``); ``greedy_actions`` are always the argmax — the
-        Evaluator consumes those without a second device call."""
+        Evaluator consumes those without a second device call. Always the
+        ``default`` policy; never queued, never shed."""
         actions, values, _, greedy_actions = self._run_rows(
             np.asarray(states)
         )
         return actions, values, greedy_actions
+
+    # -- admission ---------------------------------------------------------
+    def _admit(self, task) -> bool:
+        now = self._clock()
+        task.t_admit = now
+        if task.deadline is None and self._slo_s > 0:
+            task.deadline = now + self._slo_s
+        # task.policy stays None for routed traffic — the SCHEDULER routes
+        # whole groups (see _route_group), so un-pinned tasks all group
+        # together and canary splits never fragment batches
+        if task.policy is not None and task.policy not in self._policies:
+            # validated HERE, in the caller's thread: an unknown id reaching
+            # the scheduler would KeyError in _launch and kill the one
+            # thread the whole serving plane runs on (and mint a junk
+            # per-policy series on the way)
+            raise KeyError(
+                f"unknown policy {task.policy!r} — add_policy first"
+            )
+        if self._stop_evt.is_set():
+            self._shed(task, "shutdown")
+            return False
+        if task.deadline is not None:
+            # serving contract: a full bounded queue is an IMMEDIATE typed
+            # reject — overload must surface as fast rejection the caller
+            # can act on, never as unbounded queue latency
+            try:
+                self._queue.put_nowait(task)
+            except queue.Full:
+                self._shed(task, "queue_full")
+                return False
+        else:
+            # training contract (no deadline): backpressure pauses the
+            # caller, but stays shutdown-responsive
+            if not queue_put_stoppable(self._queue, task, self._stop_evt):
+                self._shed(task, "shutdown")
+                return False
+        if self._stop_evt.is_set():
+            # the put may have raced PAST the scheduler's final teardown
+            # drain — resolve the queue from this thread so no task is
+            # ever stranded with neither callback delivered (deque pops
+            # are atomic: concurrent drains resolve each task once)
+            self._drain_shutdown()
+        return True
+
+    def _route_group(self, weight: int) -> str:
+        """Resolve an un-pinned group's policy (scheduler thread only).
+
+        Deficit accumulator: each routed group adds ``fraction * weight``
+        of canary debt; a group dispatches to the canary when the debt
+        covers it. Over time exactly ``fraction`` of routed ROWS serve
+        the canary, with no RNG and no group fragmentation."""
+        c = self._canary
+        if c is None:
+            return "default"
+        pid, frac = c
+        self._canary_debt += frac * weight
+        if self._canary_debt >= weight:
+            self._canary_debt -= weight
+            return pid
+        return "default"
+
+    def _shed(self, task, reason: str) -> None:
+        self._c_sheds.inc(task.k)
+        if reason == "deadline":
+            self._c_shed_deadline.inc(task.k)
+            # transient-stall recovery: the estimate normally decays only
+            # at COMPLETIONS, so a one-off stall that inflates it past the
+            # whole SLO budget would shed everything forever — no
+            # completions, no decay, a permanent outage (found live: one
+            # 446 ms scheduler stall on a busy 1-core host shed 7588/7592
+            # rows of an otherwise healthy run). A FRESH task (>80% of its
+            # budget left — the estimator, not queue wait, is what killed
+            # it) decays the estimate 10%, so after a stall the scheduler
+            # probes its way back to serving; a slow probe re-measures the
+            # truth and sheds resume, bounding the probe duty cycle.
+            if task.deadline is not None:
+                budget = task.deadline - task.t_admit
+                if budget > 0 and (
+                    task.deadline - self._clock() > 0.8 * budget
+                ):
+                    self._est_serve_s *= 0.9
+        elif reason == "queue_full":
+            self._c_shed_full.inc(task.k)
+        cb = task.shed_cb
+        if cb is not None:
+            self._fire(cb, ShedReject(reason, task.deadline, self._clock()))
+
+    def _fire(self, fn, *args) -> None:
+        """Run one user callback; an exception must not kill the ONE
+        thread the whole serving plane runs on (the old N-worker design
+        at least left the other workers alive). Counted + flight-recorded
+        + logged, never silent — the missing result is the caller's
+        signal, a dead scheduler would be nobody's."""
+        try:
+            fn(*args)
+        except Exception as e:
+            self._c_cb_errors.inc()
+            try:
+                telemetry.record(
+                    "predictor_callback_error", error=str(e)[:200]
+                )
+            except Exception:
+                pass
+            logger.error("predictor callback raised %r", e)
 
     # -- internals ---------------------------------------------------------
     def _next_key(self):
@@ -249,7 +591,9 @@ class BatchedPredictor:
         return sub
 
     def _dispatch(self, params, batch: np.ndarray):
-        """Pad to the pow-2 bucket and dispatch (async); no host fetch.
+        """Pad to the pow-2 bucket and dispatch (async); NO host fetch —
+        the scheduler fetches via :meth:`_collect` only after the next
+        group is dispatched.
 
         ``params`` is passed explicitly so a multi-chunk caller serves ONE
         parameter version even if the learner publishes mid-batch."""
@@ -263,34 +607,39 @@ class BatchedPredictor:
             batch = np.concatenate([batch, pad], axis=0)
         return k, self._fwd(params, batch, self._next_key())
 
-    @staticmethod
-    def _unpack(packed: np.ndarray, k: int):
-        return (
-            packed[0, :k].astype(np.int32),
-            packed[1, :k],
-            packed[2, :k],
-            packed[3, :k].astype(np.int32),
+    def _collect(self, handle):
+        """ONE device->host fetch of a dispatched call (see fwd_sample)."""
+        k, packed = handle
+        return self._unpack(np.asarray(packed), k)
+
+    def _unpack(self, packed: np.ndarray, k: int):
+        actions = packed[0, :k].astype(np.int32)
+        if packed.shape[0] == 3:
+            # greedy server: row 0 IS the argmax channel (make_fwd_sample)
+            return actions, packed[1, :k], packed[2, :k], actions
+        return actions, packed[1, :k], packed[2, :k], packed[3, :k].astype(
+            np.int32
         )
 
     def _run_device(self, batch: np.ndarray):
-        k, packed = self._dispatch(self._params, batch)
-        # ONE device->host fetch (see fwd_sample)
-        return self._unpack(np.asarray(packed), k)
+        return self._collect(self._dispatch(self._params, batch))
+
+    @property
+    def _params(self):
+        return self._policies["default"]
 
     def _run_rows(self, states: np.ndarray):
-        """Serve N rows: (actions, values, logps, greedy_actions).
+        """Serve N rows synchronously: (actions, values, logps, greedy).
 
         Inputs larger than the serving bucket (an Evaluator with more envs
-        than ``batch_size``, or a coalesced run of block tasks) are chunked
-        to it, so no bucket beyond warmup's is ever compiled — bounded
-        device memory, and no post-warmup retrace for the BA3C_AUDIT=1
-        tripwire to refuse. The chunked path dispatches EVERY chunk before
-        fetching any: jax dispatch is async, so the chunks' compute
-        overlaps while fetches (the ~135 ms/array latency documented above)
-        drain in order — fetching inside the dispatch loop would serialize
-        compute behind readback. Params are snapshotted once per call: a
-        learner publish mid-call must not split one logical batch across
-        two policies."""
+        than ``batch_size``) are chunked to it, so no bucket beyond
+        warmup's is ever compiled — bounded device memory, and no
+        post-warmup retrace for the BA3C_AUDIT=1 tripwire to refuse. The
+        chunked path dispatches EVERY chunk before fetching any: jax
+        dispatch is async, so the chunks' compute overlaps while fetches
+        (the ~135 ms/array latency documented above) drain in order.
+        Params are snapshotted once per call: a learner publish mid-call
+        must not split one logical batch across two policies."""
         cap = _next_pow2(max(self._batch_size, 1))
         if states.shape[0] <= cap:
             return self._run_device(states)
@@ -304,98 +653,235 @@ class BatchedPredictor:
         # fetches and should resize instead (docs/observability.md)
         self._c_chunked.inc()
         self._c_chunks.inc(len(pending))
-        parts = [self._unpack(np.asarray(packed), k) for k, packed in pending]
+        parts = [self._collect(h) for h in pending]
         return tuple(np.concatenate(p) for p in zip(*parts))
 
-    def _fetch_batch(self, t: StoppableThread):
-        """Block for one task, then coalesce toward a full batch.
+    # -- the continuous-batching scheduler ---------------------------------
+    def _viable(self, task, now: float) -> bool:
+        """Can this task still make its deadline if dispatched NOW?
 
-        The reference's ``fetch_batch`` drained greedily — right when a
-        ``sess.run`` cost microseconds on local CPU. Here one device call
-        costs ~1-10 ms of (possibly tunneled) dispatch latency, so waiting
-        up to ``coalesce_ms`` to multiply the batch is a large win for the
-        actor plane (measured: greedy draining served tiny batches and
-        collapsed ZMQ-plane throughput). ``coalesce_ms=0`` restores the
-        reference behavior. Tasks are WEIGHTED: a block task counts its B
-        rows, so one ``batch_size``-sized block fills the batch alone and
-        several small blocks coalesce into one device call."""
+        The decaying-max serve-time estimate already includes pipeline
+        wait; the extra 25% headroom absorbs scheduler jitter (group
+        assembly, callback bursts, sleep-granularity overshoot on loaded
+        hosts). Both biases point the same way: the error mode is
+        shedding a task that would have made it, never serving one
+        late."""
+        return (
+            task.deadline is None
+            or now + self._est_serve_s * 1.25 <= task.deadline
+        )
+
+    def _next_task(self, t: StoppableThread, wait: bool):
+        """Pop the next VIABLE task (shedding hopeless ones on the way).
+
+        ``wait``: block stoppably (device idle) vs return None immediately
+        (a dispatch is in flight — whatever is queued right now rides the
+        next bucket, nothing more)."""
+        while True:
+            if self._held is not None:
+                task, self._held = self._held, None
+            elif wait:
+                task = t.queue_get_stoppable(self._queue)
+                if task is None:
+                    return None  # stopping
+            else:
+                try:
+                    task = self._queue.get_nowait()
+                except queue.Empty:
+                    return None
+            if self._viable(task, self._clock()):
+                return task
+            self._shed(task, "deadline")
+
+    def _assemble(self, t: StoppableThread, idle: bool):
+        """Build one ≤-bucket, single-policy group of tasks.
+
+        When the device is idle, waits for a first task and then up to
+        ``coalesce_ms`` to multiply the batch (the reference's fetch_batch
+        drained greedily — right when a sess.run cost microseconds; one
+        device call here costs ~1-10 ms of dispatch latency). When a
+        dispatch is already in flight, takes only what is queued NOW: the
+        in-flight call is the coalesce window (continuous batching)."""
         import time as _time
 
-        first = t.queue_get_stoppable(self._queue)
+        first = self._next_task(t, wait=idle)
         if first is None:
             return None
-        tasks = [first]
-        weight = first.k if isinstance(first, _BlockTask) else 1
-        deadline = _time.perf_counter() + self._coalesce_s
+        cap = _next_pow2(max(self._batch_size, 1))
+        tasks, weight = [first], first.k
+        deadline = _time.perf_counter() + (self._coalesce_s if idle else 0.0)
         while weight < self._batch_size:
-            remaining = deadline - _time.perf_counter()
-            try:
-                if remaining > 0:
-                    tk = self._queue.get(timeout=remaining)
-                else:
-                    tk = self._queue.get_nowait()
-            except queue.Empty:
+            if self._held is not None:
+                tk, self._held = self._held, None
+            else:
+                remaining = deadline - _time.perf_counter()
+                try:
+                    if remaining > 0:
+                        tk = self._queue.get(timeout=remaining)
+                    else:
+                        tk = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+            if not self._viable(tk, self._clock()):
+                self._shed(tk, "deadline")
+                continue
+            if tk.policy != first.policy or weight + tk.k > cap:
+                # one device call serves ONE policy and ONE bucket; the
+                # misfit leads the next group (never reordered past FIFO)
+                self._held = tk
                 break
             tasks.append(tk)
-            weight += tk.k if isinstance(tk, _BlockTask) else 1
-        return tasks
+            weight += tk.k
+        return tasks, weight, first.policy
 
-    def _serve_group(self, tasks) -> None:
-        """One device call for a ≤-bucket group of tasks."""
-        # counted HERE (not _run_device) so the null-device bench predictor,
-        # which overrides _run_device, keeps the same series
-        n_rows = sum(tk.k if isinstance(tk, _BlockTask) else 1 for tk in tasks)
-        self._c_batches.inc()
-        self._c_rows.inc(n_rows)
-        self._h_occupancy.observe(n_rows)
-        singles = [tk for tk in tasks if not isinstance(tk, _BlockTask)]
+    def _launch(self, group) -> List[_Inflight]:
+        """Dispatch one group (plus its shadow mirror) — no host fetch."""
+        tasks, weight, policy = group
+        if policy is None:
+            policy = self._route_group(weight)  # un-pinned: routed here
+        singles = [tk for tk in tasks if isinstance(tk, _RowTask)]
         blocks = [tk for tk in tasks if isinstance(tk, _BlockTask)]
         rows = []
         if singles:
-            rows.append(np.stack([s for s, _ in singles]))
+            rows.append(np.stack([tk.states for tk in singles]))
         rows.extend(b.states for b in blocks)
         # a lone block is served AS-IS (its states stay a zero-copy view
         # straight off the wire); mixing tasks pays one concat
         batch = rows[0] if len(rows) == 1 else np.concatenate(
             [np.asarray(r) for r in rows]
         )
-        actions, values, logps, _ = self._run_device(batch)
-        off = 0
-        if singles:
-            n = len(singles)
-            for (_, cb), a, v, lp in zip(
-                singles, actions[:n], values[:n], logps[:n]
-            ):
-                cb(int(a), float(v), float(lp))
-            off = n
-        for b in blocks:
-            b.callback(
-                actions[off:off + b.k],
-                values[off:off + b.k],
-                logps[off:off + b.k],
-            )
-            off += b.k
+        now = self._clock()
+        # counted at LAUNCH (not fetch) so the series lead the latency
+        # histograms by exactly the in-flight window
+        self._c_batches.inc()
+        self._c_rows.inc(weight)
+        self._h_occupancy.observe(weight)
+        self._policy_counter(policy).inc(weight)
+        # tasks[0] is the group's oldest admit (FIFO pop order) — captured
+        # BEFORE the singles-first reorder below
+        t_oldest = tasks[0].t_admit
+        self._h_queue_wait.observe(max(0.0, now - t_oldest))
+        ordered = singles + blocks  # callback offsets follow batch layout
+        out = [_Inflight(
+            ordered, weight, policy,
+            self._dispatch(self._policies[policy], batch), now,
+            t_oldest=t_oldest,
+        )]
+        shadow = self._shadow
+        if shadow is not None:
+            self._c_shadow_batches.inc()
+            self._c_shadow_rows.inc(weight)
+            out.append(_Inflight(
+                None, weight, shadow,
+                self._dispatch(self._policies[shadow], batch), now,
+                shadow=True,
+                states=batch if self.shadow_tap is not None else None,
+            ))
+        return out
 
-    def _worker(self) -> None:
+    def _policy_counter(self, policy: str):
+        c = self._c_policy_rows.get(policy)
+        if c is None:
+            self._c_policy_rows[policy] = c = self._tele.counter(
+                f"policy_{policy}_rows_total"
+            )
+        return c
+
+    def _complete(self, inf: _Inflight) -> None:
+        """Fetch one in-flight call and fire its callbacks."""
+        if inf.shadow:
+            tap = self.shadow_tap
+            # inf.states is captured at LAUNCH only when a tap was already
+            # installed — a tap that appears mid-flight skips this call
+            if tap is not None and inf.states is not None:
+                actions, _, _, _ = self._collect(inf.handle)
+                self._fire(tap, np.asarray(inf.states), actions, inf.policy)
+            # no tap: DROP without a host sync — shadow evaluation must
+            # never add fetch latency to the serving path
+            return
+        actions, values, logps, _ = self._collect(inf.handle)
+        now = self._clock()
+        # decaying-max serve-time estimate for the deadline gate: tracks
+        # the worst recent dispatch->fetch (incl. pipeline wait) and decays
+        # 10% per call so a one-off stall doesn't shed forever
+        self._est_serve_s = max(
+            self._est_serve_s * 0.9, now - inf.t_dispatch
+        )
+        self._h_serve.observe(max(0.0, now - inf.t_oldest))
+        late = sum(
+            tk.k for tk in inf.tasks
+            if tk.deadline is not None and now > tk.deadline
+        )
+        if late:
+            # served PAST deadline: the estimator was wrong (it never
+            # shed them) — the series that must stay ~0 for the SLO claim
+            self._c_deadline_miss.inc(late)
+        off = 0
+        for tk in inf.tasks:
+            if isinstance(tk, _RowTask):
+                self._fire(
+                    tk.callback,
+                    int(actions[off]), float(values[off]), float(logps[off]),
+                )
+                off += 1
+            else:
+                self._fire(
+                    tk.callback,
+                    actions[off:off + tk.k],
+                    values[off:off + tk.k],
+                    logps[off:off + tk.k],
+                )
+                off += tk.k
+
+    def _scheduler(self) -> None:
+        """The serving loop: dispatch-depth-pipelined continuous batching.
+
+        Invariant (the overlap lesson, docs/overlap.md): the fetch of call
+        k happens AFTER the dispatch of call k+1 whenever there is queued
+        work — the host never syncs between dispatches, so the device
+        never idles between micro-batches."""
         t = threading.current_thread()
         assert isinstance(t, StoppableThread)
-        cap = _next_pow2(max(self._batch_size, 1))
+        inflight: collections.deque = collections.deque()
         while not t.stopped():
-            tasks = self._fetch_batch(t)
-            if tasks is None:
+            group = self._assemble(t, idle=not inflight)
+            if group is not None:
+                inflight.extend(self._launch(group))
+            self._inflight_n = len(inflight)
+            # fetch the oldest call(s) once the pipeline is full — or when
+            # there is nothing new to dispatch (drain toward idle). The
+            # loop (not a single pop) keeps the depth bound even when a
+            # shadow mirror doubles the handles per group; but a drain
+            # completion re-checks the queue before fetching the next
+            # handle — work that arrived DURING the blocking fetch must be
+            # dispatched before the host blocks again (the no-sync-between-
+            # dispatches invariant, applied to the drain path too)
+            while inflight and (len(inflight) >= self._depth
+                                or group is None):
+                self._complete(inflight.popleft())
+                self._inflight_n = len(inflight)
+                if group is None:
+                    break
+        # teardown: complete what was dispatched (callers may be waiting),
+        # then deliver the promised "shutdown" reject to everything still
+        # queued — a caller waiting on either callback to resolve must not
+        # hang just because stop() won the race
+        while inflight:
+            self._complete(inflight.popleft())
+        self._inflight_n = 0
+        if self._held is not None:
+            held, self._held = self._held, None
+            self._shed(held, "shutdown")
+        self._drain_shutdown()
+
+    def _drain_shutdown(self) -> None:
+        """Shed everything still queued with the promised "shutdown"
+        reject. Called by the scheduler at teardown AND by ``_admit`` when
+        its put raced past that final drain — deque pops are atomic, so
+        concurrent drains resolve each task exactly once."""
+        while True:
+            try:
+                task = self._queue.get_nowait()
+            except queue.Empty:
                 return
-            # pack into groups that fit the warmed bucket: coalescing can
-            # overshoot by up to one block, and a batch beyond the bucket
-            # would compile a NEW program mid-serving (the BA3C_AUDIT
-            # tripwire refuses exactly that)
-            group: list = []
-            weight = 0
-            for tk in tasks:
-                k = tk.k if isinstance(tk, _BlockTask) else 1
-                if group and weight + k > cap:
-                    self._serve_group(group)
-                    group, weight = [], 0
-                group.append(tk)
-                weight += k
-            if group:
-                self._serve_group(group)
+            self._shed(task, "shutdown")

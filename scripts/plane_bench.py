@@ -40,6 +40,8 @@ import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+# sibling-script import surface (serving_bench rides along under --serving)
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 
 def main() -> int:
@@ -71,6 +73,20 @@ def main() -> int:
     )
     ap.add_argument("--tpu_lock", default="wait", choices=["wait", "fail", "off"])
     ap.add_argument(
+        "--foil_shape", default="256/32",
+        help="per-env foil fleet shape as N_ENVS/ENVS_PER_PROC. The "
+        "historical 256/32 (the shape PERF.md's 2,128 baseline was pinned "
+        "at) no longer comes up on this container (PERF.md round 7) — "
+        "pass a feasible shape (e.g. 64/16) to re-measure the foil; the "
+        "shape is recorded in the JSON row either way",
+    )
+    ap.add_argument(
+        "--serving", action="store_true",
+        help="ALSO run the SLO-serving latency-vs-throughput frontier "
+        "(scripts/serving_bench.py default sweep) and embed it under "
+        "'serving' in the JSON; its SLO gate failures fail this run",
+    )
+    ap.add_argument(
         "--telemetry", default="on", choices=["on", "off", "both"],
         help="telemetry plane A/B: on = production default (instrumented "
         "masters/servers, fleet piggyback), off = BA3C_TELEMETRY=0 "
@@ -92,6 +108,17 @@ def main() -> int:
     for w in wires:
         if w not in ("block-shm", "block", "per-env"):
             raise SystemExit(f"unknown wire mode {w!r}")
+    try:
+        foil_envs, foil_per = (
+            int(x) for x in args.foil_shape.replace("x", "/").split("/")
+        )
+        if foil_envs <= 0 or foil_per <= 0:
+            raise ValueError
+    except ValueError:
+        raise SystemExit(
+            f"--foil_shape {args.foil_shape!r} must be N_ENVS/ENVS_PER_PROC "
+            "with both positive (e.g. 256/32)"
+        )
 
     if not args.device:
         # device-free: no accelerator in the loop, so no TPU claim and no
@@ -116,11 +143,12 @@ def main() -> int:
     gate_failures = []
     for wire in wires:
         if wire == "per-env":
-            # the compat foil is measured at ITS OWN historical config
-            # (256 envs in 32-env servers — the shape PERF.md's 2,128
-            # baseline was pinned at); hundreds of DEALER sockets per
+            # the compat foil is measured at ITS OWN fleet shape —
+            # historically 256/32 (the shape PERF.md's 2,128 baseline was
+            # pinned at), --foil_shape when that doesn't come up on the
+            # host (PERF.md round 7); hundreds of DEALER sockets per
             # process is not a shape the per-env wire ever ran at
-            n_envs, per = min(256, args.n_envs), 32
+            n_envs, per = min(foil_envs, args.n_envs), foil_per
         else:
             n_envs, per = args.n_envs, args.envs_per_proc
         if args.telemetry == "both":
@@ -141,6 +169,8 @@ def main() -> int:
                         windows=args.windows, telemetry_on=tele_on,
                     )
                     tag = "on" if tele_on else "off"
+                    if wire == "per-env":
+                        r["foil_shape"] = f"{n_envs}/{per}"
                     (on_vals if tele_on else off_vals).append(r["value"])
                     runs[f"nodevice_{wire}_telemetry_{tag}_rep{rep}"] = r
                     if tele_on:
@@ -179,6 +209,10 @@ def main() -> int:
                 null_device=True, wire=wire, envs_per_proc=per,
                 windows=args.windows, telemetry_on=args.telemetry != "off",
             )
+            if wire == "per-env":
+                # the foil's fleet shape is part of the number — rows are
+                # not comparable across shapes (PERF.md rounds 4/7)
+                r["foil_shape"] = f"{n_envs}/{per}"
             runs[f"nodevice_{wire}"] = r
             stderr_print(
                 f"device-free {wire:8s}: {r['value']:>10.1f} env-steps/s/host"
@@ -216,6 +250,16 @@ def main() -> int:
         # ratio per wire, all measured alternating in THIS session
         # (PERF.md round 7 cites it)
         out["telemetry_overhead_on_over_off"] = overhead
+    if args.serving:
+        # the SLO-serving frontier rides along (scripts/serving_bench.py
+        # owns the sweep + gate; its default shape is device-free)
+        import serving_bench
+
+        serving_row, serving_failures = serving_bench.run_frontier(
+            serving_bench.parse_opts([])
+        )
+        out["serving"] = serving_row
+        gate_failures.extend(serving_failures)
     print(json.dumps(out))
     if gate_failures:
         for msg in gate_failures:

@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""Latency-vs-throughput frontier for the SLO-aware serving plane.
+
+Drives the real ``BatchedPredictor`` scheduler — continuous batching,
+deadline admission, load shedding (docs/serving.md) — with OPEN-LOOP
+Poisson arrivals at a sweep of offered rates, and publishes per-rate
+p50/p90/p99 serve latency, shed rate and batch occupancy: the frontier the
+way ``plane_bench_r6/r7`` publish throughput.
+
+Open-loop matters: a closed-loop driver slows down with the server and
+hides the overload region entirely; here arrivals keep coming at the
+offered rate no matter what, so past saturation the plane must SHED (fast
+typed rejects) while the p99 of what it does serve stays under the SLO —
+that is the acceptance shape, load shedding rather than latency collapse.
+
+Device-free by default: the device is the plane-bench null predictor with
+a SIMULATED per-call service time (``--service_us``, slept at fetch like a
+real serialized device queue), so the frontier's service-time axis is real
+while no accelerator (and no tunnel RTT) is in the loop —
+``device_free_proxy: true`` in the JSON, same convention as BENCH_r06.
+
+Prints ONE JSON line on stdout (the repo's bench-tooling contract), with
+the per-rate evidence BEFORE any gate verdict; diagnostics go to stderr.
+
+Usage:
+  python scripts/serving_bench.py                       # default sweep + gate
+  python scripts/serving_bench.py --rates 1000,4000 --seconds 2   # CI smoke
+  python scripts/plane_bench.py --serving               # embedded in the
+                                                        # plane instrument
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _percentiles_ms(lats):
+    import numpy as np
+
+    if not lats:
+        return None, None, None
+    arr = np.asarray(lats) * 1000.0
+    return (
+        round(float(np.percentile(arr, 50)), 3),
+        round(float(np.percentile(arr, 90)), 3),
+        round(float(np.percentile(arr, 99)), 3),
+    )
+
+
+def run_point(rate_rows_per_s: float, opts) -> dict:
+    """One open-loop rate point: fresh predictor, Poisson arrivals of
+    ``block_rows``-row block tasks for ``seconds``, drained to completion."""
+    import numpy as np
+
+    from distributed_ba3c_tpu import telemetry
+    from bench import make_null_predictor
+
+    telemetry.reset_all()
+    # a stub model is enough: the null predictor never traces the forward,
+    # and the scheduler only reads num_actions for the fallback contract
+    model = SimpleNamespace(num_actions=opts.num_actions, apply=None)
+    pred = make_null_predictor(
+        model, {}, opts.num_actions,
+        service_s=opts.service_us / 1e6,
+        batch_size=opts.batch_size,
+        coalesce_ms=0.0,
+        slo_ms=opts.slo_ms,
+        queue_depth=opts.queue_depth,
+    )
+    pred.start()
+    lats: list = []    # served: admit -> callback, seconds
+    sheds: list = []   # ShedReject.reason per shed task
+    state = np.zeros((opts.block_rows, 1), np.uint8)  # content is irrelevant
+    rng = np.random.default_rng(opts.seed)
+    n_tasks = max(1, int(opts.seconds * rate_rows_per_s / opts.block_rows))
+    mean_gap = opts.block_rows / rate_rows_per_s
+    gaps = rng.exponential(mean_gap, n_tasks)
+    clock = time.monotonic
+    try:
+        t_start = clock()
+        next_t = t_start
+        for i in range(n_tasks):
+            next_t += gaps[i]
+            now = clock()
+            if next_t > now:
+                time.sleep(next_t - now)
+            t0 = clock()
+
+            def cb(a, v, lp, t0=t0):
+                lats.append(clock() - t0)
+
+            def shed_cb(rej):
+                sheds.append(rej.reason)
+
+            pred.put_block_task(state, cb, shed_callback=shed_cb)
+        submit_elapsed = clock() - t_start
+        # drain: every deadline'd task resolves (served, or shed at pop)
+        deadline = clock() + opts.slo_ms / 1000.0 * 4 + 10.0
+        while len(lats) + len(sheds) < n_tasks and clock() < deadline:
+            time.sleep(0.01)
+        # served throughput is measured over the WHOLE service window
+        # (submission + drain): dividing drain-phase completions by the
+        # submission window alone would overstate capacity exactly at the
+        # knee, where the backlog drains after arrivals stop
+        total_elapsed = clock() - t_start
+    finally:
+        pred.stop()
+        pred.join(timeout=5)
+    scal = telemetry.registry("predictor").scalars()
+    batches = scal.get("batches_total", 0)
+    rows = scal.get("rows_total", 0)
+    p50, p90, p99 = _percentiles_ms(lats)
+    served = len(lats)
+    shed = len(sheds)
+    return {
+        "offered_rows_per_s": round(
+            n_tasks * opts.block_rows / max(submit_elapsed, 1e-9), 1
+        ),
+        "target_rows_per_s": rate_rows_per_s,
+        "submitted_tasks": n_tasks,
+        "served_tasks": served,
+        "shed_tasks": shed,
+        "unresolved_tasks": n_tasks - served - shed,
+        "shed_rate": round(shed / n_tasks, 4),
+        "sheds_by_reason": {
+            r: sheds.count(r) for r in sorted(set(sheds))
+        },
+        "p50_ms": p50,
+        "p90_ms": p90,
+        "p99_ms": p99,
+        "served_rows_per_s": round(
+            served * opts.block_rows / max(total_elapsed, 1e-9), 1
+        ),
+        "mean_batch_rows": round(rows / batches, 2) if batches else None,
+        "deadline_misses": scal.get("deadline_misses_total", 0),
+    }
+
+
+def run_frontier(opts) -> tuple:
+    """The full sweep + gate. Returns (json_row, gate_failure_messages)."""
+    from distributed_ba3c_tpu.utils.devicelock import stderr_print
+
+    points = []
+    for rate in opts.rates:
+        p = run_point(rate, opts)
+        points.append(p)
+        stderr_print(
+            f"serving {rate:>8.0f} rows/s offered: "
+            f"p99={p['p99_ms']} ms shed={p['shed_rate']:.1%} "
+            f"occupancy={p['mean_batch_rows']}"
+        )
+
+    slo = opts.slo_ms
+    failures = []
+    ok = [
+        p for p in points
+        if p["shed_rate"] < 0.01 and p["p99_ms"] is not None
+        and p["p99_ms"] <= slo
+    ]
+    best = max(ok, key=lambda p: p["offered_rows_per_s"]) if ok else None
+    if best is None:
+        failures.append(
+            f"serving gate FAILED: no rate point met the SLO "
+            f"(p99 <= {slo} ms with shed < 1%)"
+        )
+        overload = None
+    else:
+        over = [
+            p for p in points
+            if p["offered_rows_per_s"] >= 2 * best["offered_rows_per_s"]
+        ]
+        overload = max(over, key=lambda p: p["offered_rows_per_s"]) \
+            if over else None
+        if overload is None:
+            failures.append(
+                "serving gate FAILED: sweep never reached 2x the best "
+                f"SLO-meeting rate ({best['offered_rows_per_s']} rows/s) — "
+                "extend --rates to cover overload"
+            )
+        else:
+            if not overload["shed_rate"] > best["shed_rate"]:
+                failures.append(
+                    "serving gate FAILED: 2x overload did not raise the "
+                    f"shed rate ({overload['shed_rate']} vs "
+                    f"{best['shed_rate']} at the SLO point)"
+                )
+            if overload["p99_ms"] is not None and overload["p99_ms"] > slo:
+                failures.append(
+                    "serving gate FAILED: served-task p99 "
+                    f"{overload['p99_ms']} ms exceeded the {slo} ms SLO "
+                    "under overload — latency collapse, not load shedding"
+                )
+    out = {
+        "metric": "serving_frontier_rows_per_s_vs_latency",
+        "unit": "rows/sec vs ms",
+        "slo_ms": slo,
+        "block_rows": opts.block_rows,
+        "batch_size": opts.batch_size,
+        "service_us": opts.service_us,
+        "queue_depth": opts.queue_depth,
+        "seconds": opts.seconds,
+        "seed": opts.seed,
+        # same convention as BENCH_r06: no accelerator in the loop; the
+        # service-time axis is simulated at the null device's fetch
+        "device_free_proxy": True,
+        "rate_points": points,
+        "gate": {
+            "criterion": (
+                f"exists rate point with p99 <= {slo} ms and shed < 1%; at "
+                ">= 2x that rate, shed rises while served p99 stays <= SLO"
+            ),
+            "best_slo_point_rows_per_s": (
+                best["offered_rows_per_s"] if best else None
+            ),
+            "overload_point_rows_per_s": (
+                overload["offered_rows_per_s"] if overload else None
+            ),
+            "passed": not failures,
+        },
+    }
+    return out, failures
+
+
+def parse_opts(argv=None) -> SimpleNamespace:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--rates", default="1000,2000,4000,8000,16000",
+        help="comma list of offered rates in ROWS/s (each request is a "
+        "--block_rows block). The default tops out at ~2x the default "
+        "service capacity so the sweep covers both sides of the knee",
+    )
+    ap.add_argument(
+        "--block_rows", type=int, default=8,
+        help="rows per request (the block wire's natural request unit)",
+    )
+    ap.add_argument(
+        "--batch_size", type=int, default=32,
+        help="predictor coalesce target; the bucket cap is the next pow-2 "
+        "(capacity = cap rows per --service_us device call)",
+    )
+    ap.add_argument(
+        "--service_us", type=float, default=4000.0,
+        help="simulated device time per call (slept at fetch) — the "
+        "frontier's service-time axis on a device-free host",
+    )
+    ap.add_argument("--slo_ms", type=float, default=50.0)
+    ap.add_argument(
+        "--queue_depth", type=int, default=64,
+        help="admission-queue bound in TASKS (overload beyond it is fast "
+        "queue_full rejection)",
+    )
+    ap.add_argument("--seconds", type=float, default=4.0, help="per rate point")
+    ap.add_argument("--num_actions", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    rates = [float(r) for r in args.rates.split(",") if r.strip()]
+    if not rates:
+        raise SystemExit("--rates must name at least one rate")
+    return SimpleNamespace(rates=rates, **{
+        k: getattr(args, k)
+        for k in ("block_rows", "batch_size", "service_us", "slo_ms",
+                  "queue_depth", "seconds", "num_actions", "seed")
+    })
+
+
+def main(argv=None) -> int:
+    # no accelerator in the loop, ever: pin cpu BEFORE jax imports and
+    # never take the TPU-claim mutex (same stance as plane_bench
+    # device-free mode)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    opts = parse_opts(argv)
+    out, failures = run_frontier(opts)
+    # the JSON (per-point evidence) prints BEFORE any gate verdict — the
+    # evidence is most valuable exactly when the gate fails
+    print(json.dumps(out))
+    if failures:
+        from distributed_ba3c_tpu.utils.devicelock import stderr_print
+
+        for msg in failures:
+            stderr_print(msg)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,21 @@
+"""A9 clean fixture: the idioms the real serving plane uses.
+
+Bounded queues (literal or computed bound), bounded-timeout waits, no
+sleeps/prints/file I/O on the scheduler path.
+"""
+import queue
+
+from distributed_ba3c_tpu.utils.concurrency import FastQueue
+
+DEPTH = 4096
+
+admission = FastQueue(maxsize=4096)
+sized = FastQueue(maxsize=DEPTH)  # computed bound: sizing policy, not A9's
+small = queue.Queue(maxsize=256)
+
+
+def scheduler_tick(q):
+    try:
+        return q.get(timeout=0.5)
+    except queue.Empty:
+        return None

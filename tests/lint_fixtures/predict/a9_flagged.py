@@ -1,0 +1,16 @@
+"""A9 fixture: blocking I/O and unbounded queues in the serving plane.
+
+Lives under a ``predict/`` directory on purpose — the rule only applies
+there (the serving hot path, docs/serving.md).
+"""
+import queue
+import time
+
+tasks = queue.Queue()  # unbounded admission queue
+backlog = queue.Queue(maxsize=0)  # maxsize=0 is queue.Queue's unbounded
+
+
+def scheduler_tick(sock):
+    time.sleep(0.01)  # stalls every in-flight request
+    print("batch dispatched")  # console I/O on the hot path
+    sock.send(b"reply")  # wire I/O belongs to the masters

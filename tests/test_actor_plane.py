@@ -25,7 +25,7 @@ from distributed_ba3c_tpu.utils.concurrency import ensure_proc_terminate
 class _NullPredictor:
     """Predictor stub for parse-logic tests (never called)."""
 
-    def put_task(self, state, cb):
+    def put_task(self, state, cb, **kw):
         raise AssertionError("should not be called")
 
 

@@ -270,6 +270,7 @@ def test_registry_names():
         "parallel.train_step",
         "parallel.vtrace_step",
         "predict.server",
+        "predict.server_greedy",
     ]
 
 

@@ -19,11 +19,16 @@ from tools.ba3clint.engine import suppressions
 FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-RULE_IDS = ["J1", "J2", "J3", "J4", "J5", "J6", "A1", "A2", "A3", "A4", "A5", "A6", "A7", "A8"]
+RULE_IDS = ["J1", "J2", "J3", "J4", "J5", "J6", "A1", "A2", "A3", "A4", "A5", "A6", "A7", "A8", "A9"]
 
 
 def _fixture(name):
-    return os.path.join(FIXTURES, name)
+    p = os.path.join(FIXTURES, name)
+    if not os.path.exists(p):
+        # path-gated rules keep their fixtures under the directory that
+        # activates them (A9 lives in lint_fixtures/predict/)
+        p = os.path.join(FIXTURES, "predict", name)
+    return p
 
 
 def _findings(name, rule_id=None):
@@ -69,6 +74,7 @@ def test_expected_flag_counts():
     assert len(_findings("a6_flagged.py", "A6")) == 3
     assert len(_findings("a7_flagged.py", "A7")) == 4
     assert len(_findings("j6_flagged.py", "J6")) == 4
+    assert len(_findings("a9_flagged.py", "A9")) == 5
 
 
 def test_a7_exempts_telemetry_package(tmp_path):
@@ -81,6 +87,20 @@ def test_a7_exempts_telemetry_package(tmp_path):
     g = tmp_path / "loop.py"
     g.write_text("import time\nfps = 3 / (time.time() - 1)\n")
     assert [x for x in lint_file(str(g), all_rules()) if x.rule == "A7"]
+
+
+def test_a9_applies_only_under_predict(tmp_path):
+    """The same unbounded queue outside predict/ is A9-silent (A2/A7 own
+    the neighboring hazards elsewhere)."""
+    src = "import queue\ntasks = queue.Queue()\n"
+    outside = tmp_path / "dataflow.py"
+    outside.write_text(src)
+    assert [f for f in lint_file(str(outside), all_rules()) if f.rule == "A9"] == []
+    d = tmp_path / "predict"
+    d.mkdir()
+    inside = d / "server2.py"
+    inside.write_text(src)
+    assert [f for f in lint_file(str(inside), all_rules()) if f.rule == "A9"]
 
 
 def test_suppressions_silence_real_violations():

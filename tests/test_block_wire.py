@@ -48,11 +48,11 @@ def _policy(state: np.ndarray):
 class _DetPredictor:
     """Synchronous deterministic predictor stub speaking BOTH task APIs."""
 
-    def put_task(self, state, cb):
+    def put_task(self, state, cb, **kw):
         a, v, lp = _policy(state)
         cb(a, v, lp)
 
-    def put_block_task(self, states, cb):
+    def put_block_task(self, states, cb, **kw):
         outs = [_policy(states[j]) for j in range(states.shape[0])]
         cb(
             np.asarray([o[0] for o in outs], np.int32),

@@ -40,7 +40,7 @@ def _prune_master(tmp_path):
     from distributed_ba3c_tpu.actors.master import BA3CSimulatorMaster
 
     class _P:
-        def put_task(self, s, cb):
+        def put_task(self, s, cb, **kw):
             pass
 
     m = BA3CSimulatorMaster(

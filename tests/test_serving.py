@@ -1,0 +1,559 @@
+"""SLO-aware serving plane (predict/server.py, docs/serving.md).
+
+Deterministic fake-clock tests of the continuous-batching scheduler's
+deadline semantics — a saturated predictor SHEDS late tasks with a typed
+reject and never executes a task past its deadline, admitted-task p99 stays
+bounded under sustained overload — plus canary/shadow multi-policy
+contracts and the BA3C_AUDIT=1 trace-stability of continuous batching.
+
+The fake clock drives every scheduler decision (admission stamps,
+viability, latency accounting); the null device advances it by a fixed
+service time per fetched call, so the whole overload scenario plays out in
+deterministic virtual time while threads synchronize on real events.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.predict.server import (
+    BatchedPredictor,
+    ShedReject,
+    make_fwd_sample,
+)
+
+N_ACTIONS = 4
+STATE = (4, 4, 2)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self._lock = threading.Lock()
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        with self._lock:
+            self.t += dt
+
+
+class _NullPred(BatchedPredictor):
+    """Null device in VIRTUAL time: each fetched call advances the fake
+    clock by ``service_s`` — the deterministic analogue of a serialized
+    device queue."""
+
+    service_s = 0.0
+    vclock = None
+
+    def _dispatch(self, params, batch):
+        b = np.asarray(batch)
+        k = b.shape[0]
+        acts = (np.arange(k) % N_ACTIONS).astype(np.int32)
+        return k, (
+            acts,
+            np.zeros(k, np.float32),
+            np.full(k, -1.0, np.float32),
+            acts,
+        )
+
+    def _collect(self, handle):
+        if self.vclock is not None and self.service_s:
+            self.vclock.advance(self.service_s)
+        return handle[1]
+
+
+def _null_pred(service_s=0.0, **kw):
+    telemetry.reset_all()
+    clock = _FakeClock()
+    model = SimpleNamespace(num_actions=N_ACTIONS, apply=None)
+    kw.setdefault("coalesce_ms", 0.0)
+    pred = _NullPred(model, {}, clock=clock, **kw)
+    pred.service_s = service_s
+    pred.vclock = clock
+    return pred, clock
+
+
+def _drain(pred, resolved, total, timeout=20.0):
+    """Wait (real time) until ``total`` tasks resolved in virtual time."""
+    deadline = time.monotonic() + timeout
+    while resolved() < total and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert resolved() == total, f"only {resolved()}/{total} tasks resolved"
+
+
+def _pred_scalar(name):
+    return telemetry.registry("predictor").scalars().get(name, 0.0)
+
+
+# -- deadline semantics ------------------------------------------------------
+
+
+def test_expired_task_is_shed_with_typed_reject():
+    """A task whose deadline passed while queued is never served."""
+    pred, clock = _null_pred(batch_size=8, queue_depth=16)
+    served, sheds = [], []
+    evt = threading.Event()
+    pred.put_block_task(
+        np.zeros((4, *STATE), np.uint8),
+        lambda a, v, lp: served.append(a),
+        deadline=clock() + 0.05,
+        shed_callback=lambda r: (sheds.append(r), evt.set()),
+    )
+    clock.advance(0.1)  # the deadline passes while the task sits queued
+    pred.start()
+    try:
+        assert evt.wait(10)
+        assert served == []
+        assert isinstance(sheds[0], ShedReject)
+        assert sheds[0].reason == "deadline"
+        assert _pred_scalar("sheds_deadline_total") == 4  # rows, not tasks
+    finally:
+        pred.stop()
+        pred.join(timeout=5)
+
+
+def test_full_admission_queue_rejects_fast():
+    """Overload past the bounded queue is an immediate typed reject, not a
+    blocking wait — the scheduler is deliberately not running."""
+    pred, _ = _null_pred(batch_size=8, queue_depth=4, slo_ms=1000.0)
+    rejects = []
+    admitted = 0
+    try:
+        for _ in range(10):
+            admitted += pred.put_block_task(
+                np.zeros((2, *STATE), np.uint8),
+                lambda a, v, lp: None,
+                shed_callback=lambda r: rejects.append(r),
+            )
+        assert admitted == 4
+        assert len(rejects) == 6
+        assert all(r.reason == "queue_full" for r in rejects)
+        assert _pred_scalar("sheds_queue_full_total") == 12  # 6 tasks x 2 rows
+    finally:
+        pred.stop()
+
+
+def test_overload_sheds_but_admitted_p99_stays_bounded():
+    """2x sustained overload: shed rate rises, NO task executes past its
+    deadline, and the latency of everything actually served stays <= SLO
+    (load shedding, not latency collapse)."""
+    slo_s = 0.05
+    service_s = 0.01
+    pred, clock = _null_pred(
+        service_s=service_s, batch_size=8, queue_depth=64, slo_ms=1000 * slo_s
+    )
+    # capacity: one 8-row call per 10 ms of virtual time = 800 rows/s;
+    # each round bursts 2x the rows a full SLO window can serve
+    per_round = 2 * int(slo_s / service_s)
+    lats, sheds = [], []
+    pred.start()
+    try:
+        for _ in range(3):  # sustained: pressure re-applied every round
+            t0 = clock()
+
+            def cb(a, v, lp, t0=t0):
+                lats.append(clock() - t0)
+
+            before = len(lats) + len(sheds)
+            for _ in range(per_round):
+                pred.put_block_task(
+                    np.zeros((8, *STATE), np.uint8), cb,
+                    shed_callback=lambda r: sheds.append(r),
+                )
+            _drain(
+                pred, lambda: len(lats) + len(sheds), before + per_round
+            )
+    finally:
+        pred.stop()
+        pred.join(timeout=5)
+    assert sheds, "2x overload produced no sheds"
+    assert all(r.reason in ("deadline", "queue_full") for r in sheds)
+    # the SLO claim, in virtual time: nothing served ran past its budget
+    assert max(lats) <= slo_s + 1e-9, f"served latency {max(lats)} > SLO"
+    # and the scheduler PROVED it: zero rows served past their deadline
+    assert _pred_scalar("deadline_misses_total") == 0
+    assert len(lats) >= 3  # the plane kept serving while shedding
+
+
+def test_no_deadline_means_backpressure_and_full_service():
+    """Without deadlines (the training plane's contract) nothing is ever
+    shed — every task is served, in FIFO order."""
+    pred, _ = _null_pred(batch_size=4, queue_depth=256)
+    got = []
+    done = threading.Event()
+    n = 50
+
+    def cb(i):
+        def _cb(a, v, lp):
+            got.append(i)
+            if len(got) == n:
+                done.set()
+
+        return _cb
+
+    for i in range(n):
+        pred.put_task(np.zeros(STATE, np.uint8), cb(i))
+    pred.start()
+    try:
+        assert done.wait(20)
+        assert got == list(range(n))
+        assert _pred_scalar("sheds_total") == 0
+    finally:
+        pred.stop()
+        pred.join(timeout=5)
+
+
+def test_estimator_recovers_after_transient_stall():
+    """A one-off stall that inflates the serve-time estimate past the
+    whole SLO budget must NOT shed forever: fresh-task sheds decay the
+    estimate until a probe gets through and re-measures the truth (found
+    live — a 446 ms scheduler stall on a busy host otherwise turned a
+    healthy plane into a permanent 100%-shed outage)."""
+    slo_s = 0.05
+    pred, clock = _null_pred(
+        service_s=0.2, batch_size=8, queue_depth=64, slo_ms=1000 * slo_s
+    )
+    served, sheds = [], []
+    pred.start()
+    try:
+        # the stall: one 200 ms call inflates the estimate to 4x the SLO
+        pred.put_block_task(
+            np.zeros((8, *STATE), np.uint8),
+            lambda a, v, lp: served.append(1),
+            shed_callback=lambda r: sheds.append(r),
+        )
+        _drain(pred, lambda: len(served) + len(sheds), 1)
+        assert served == [1]  # est was still 0 — the stall call serves
+        # back to a healthy device
+        pred.service_s = 0.01
+        # fresh tasks trickle in; each full-budget shed decays the
+        # estimate 10%, so service MUST resume within a bounded number
+        for i in range(2, 42):
+            pred.put_block_task(
+                np.zeros((8, *STATE), np.uint8),
+                lambda a, v, lp: served.append(1),
+                shed_callback=lambda r: sheds.append(r),
+            )
+            _drain(pred, lambda: len(served) + len(sheds), i)
+            if len(served) >= 3:
+                break
+    finally:
+        pred.stop()
+        pred.join(timeout=5)
+    assert sheds, "the inflated estimate should shed the first probes"
+    assert len(served) >= 3, (
+        "the plane never recovered from the transient stall — the "
+        "estimator death-spiraled"
+    )
+
+
+# -- multi-policy serving ----------------------------------------------------
+
+
+def test_canary_routing_is_deterministic_fraction():
+    pred, _ = _null_pred(batch_size=4, queue_depth=256)
+    pred.add_policy("canary", {})
+    pred.set_canary("canary", 0.25)
+    n = 16
+    done = threading.Event()
+    served = []
+
+    def cb(a, v, lp):
+        served.append(a)
+        if len(served) == n:
+            done.set()
+
+    for _ in range(n):
+        pred.put_task(np.zeros(STATE, np.uint8), cb)
+    pred.start()
+    try:
+        assert done.wait(20)
+        # deficit-accumulator split at group granularity: 4 groups of 4
+        # rows, the 4th's debt covers it — exactly fraction*n rows, no
+        # RNG, and no group ever fragmented at a policy boundary
+        assert _pred_scalar("policy_canary_rows_total") == 4
+        assert _pred_scalar("policy_default_rows_total") == 12
+    finally:
+        pred.stop()
+        pred.join(timeout=5)
+
+
+def test_policy_table_validation():
+    pred, _ = _null_pred(batch_size=4)
+    try:
+        with pytest.raises(ValueError, match="policy id"):
+            pred.add_policy("Not-Valid!", {})
+        with pytest.raises(KeyError, match="unknown policy"):
+            pred.set_canary("ghost", 0.5)
+        with pytest.raises(KeyError, match="unknown policy"):
+            pred.set_shadow("ghost")
+        with pytest.raises(KeyError, match="unknown policy"):
+            # a typo'd republish must fail loudly, never mint a dead entry
+            # while the real policy keeps serving stale weights
+            pred.update_params({}, policy="ghost")
+        with pytest.raises(KeyError, match="unknown policy"):
+            # validated in the CALLER's thread — an unknown id reaching the
+            # scheduler would kill the one thread the plane runs on
+            pred.put_task(
+                np.zeros(STATE, np.uint8), lambda *a: None, policy="ghost"
+            )
+        pred.add_policy("ok_2", {})
+        with pytest.raises(ValueError, match="fraction"):
+            pred.set_canary("ok_2", 1.5)
+        pred.set_canary("ok_2", 0.5)
+        pred.set_canary("ok_2", 0)  # 0 clears
+        assert pred._canary is None
+    finally:
+        pred.stop()
+
+
+def test_raising_callback_does_not_kill_the_scheduler():
+    """One bad caller's exception must not take down the one thread the
+    whole serving plane runs on — it is counted, and service continues."""
+    pred, _ = _null_pred(batch_size=4, queue_depth=64)
+    served = []
+    done = threading.Event()
+    pred.start()
+    try:
+        pred.put_task(
+            np.zeros(STATE, np.uint8),
+            lambda a, v, lp: (_ for _ in ()).throw(RuntimeError("bad cb")),
+        )
+        pred.put_task(
+            np.zeros(STATE, np.uint8),
+            lambda a, v, lp: (served.append(a), done.set()),
+        )
+        assert done.wait(20), "scheduler died on the raising callback"
+        assert served and pred.threads[0].is_alive()
+        assert _pred_scalar("callback_errors_total") == 1
+    finally:
+        pred.stop()
+        pred.join(timeout=5)
+
+
+def test_stop_delivers_shutdown_reject_to_queued_tasks():
+    """A task queued when stop() wins the race gets the promised typed
+    "shutdown" reject — a caller waiting on either callback must not
+    hang."""
+    pred, _ = _null_pred(batch_size=4, queue_depth=16, slo_ms=1000.0)
+    sheds = []
+    served = []
+    for _ in range(3):
+        pred.put_block_task(
+            np.zeros((2, *STATE), np.uint8),
+            lambda a, v, lp: served.append(a),
+            shed_callback=lambda r: sheds.append(r),
+        )
+    # scheduler was never started: stop() must still resolve the queue
+    pred.stop()
+    pred.threads[0].start()  # runs straight into teardown drain
+    pred.join(timeout=10)
+    assert served == []
+    assert len(sheds) == 3
+    assert all(r.reason == "shutdown" for r in sheds)
+
+
+def _real_model_and_params(seed):
+    import jax
+
+    from distributed_ba3c_tpu.config import BA3CConfig
+    from distributed_ba3c_tpu.models.a3c import BA3CNet
+
+    cfg = BA3CConfig(image_size=(16, 16), fc_units=16, num_actions=N_ACTIONS)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    params = model.init(
+        jax.random.PRNGKey(seed), np.zeros((1, *cfg.state_shape), np.uint8)
+    )["params"]
+    return cfg, model, params
+
+
+def test_shadow_sees_identical_states_and_never_reaches_callers():
+    """Canary/shadow parity (ISSUE 9): the shadow policy is dispatched the
+    IDENTICAL batch, and the caller's actions come from the primary policy
+    only — greedy mode makes both sides deterministic."""
+    import jax
+
+    telemetry.reset_all()
+    cfg, model, params0 = _real_model_and_params(0)
+    _, _, params1 = _real_model_and_params(7)
+    pred = BatchedPredictor(model, params0, batch_size=8, greedy=True)
+    pred.add_policy("shadow_p", params1)
+    pred.set_shadow("shadow_p")
+    taps = []
+    pred.shadow_tap = lambda states, actions, pid: taps.append(
+        (states, actions, pid)
+    )
+    rng = np.random.default_rng(3)
+    states = rng.integers(0, 255, (5, *cfg.state_shape)).astype(np.uint8)
+    got = []
+    evt = threading.Event()
+    pred.put_block_task(states, lambda a, v, lp: (got.append(a), evt.set()))
+    pred.start()
+    try:
+        assert evt.wait(60)
+        deadline = time.monotonic() + 30
+        while not taps and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert taps, "shadow mirror never fetched through the tap"
+
+        def greedy_actions(params):
+            out = model.apply({"params": jax.device_get(params)}, states)
+            return np.argmax(np.asarray(out.logits), axis=-1)
+
+        # callers got the PRIMARY policy's deterministic actions
+        np.testing.assert_array_equal(got[0], greedy_actions(params0))
+        tap_states, tap_actions, pid = taps[0]
+        assert pid == "shadow_p"
+        # the shadow saw the identical states...
+        np.testing.assert_array_equal(tap_states, states)
+        # ...and produced the SHADOW policy's actions, which went nowhere
+        np.testing.assert_array_equal(tap_actions, greedy_actions(params1))
+        assert _pred_scalar("shadow_rows_total") == 5
+    finally:
+        pred.stop()
+        pred.join(timeout=5)
+
+
+# -- packed-fetch shapes (make_fwd_sample satellite) ------------------------
+
+
+def test_fwd_sample_packed_shapes():
+    """greedy=True drops the duplicated argmax row: [3, B] vs [4, B] —
+    both shapes are pinned by their own audit entries (T5)."""
+    import jax
+
+    cfg, model, params = _real_model_and_params(0)
+    B = 4
+    states = jax.ShapeDtypeStruct((B, *cfg.state_shape), np.uint8)
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    p_aval = jax.eval_shape(lambda: params)
+    sampling = jax.eval_shape(make_fwd_sample(model, False), p_aval, states, key)
+    greedy = jax.eval_shape(make_fwd_sample(model, True), p_aval, states, key)
+    assert sampling.shape == (4, B)
+    assert greedy.shape == (3, B)
+
+
+def test_greedy_predict_batch_actions_are_argmax():
+    cfg, model, params = _real_model_and_params(0)
+    pred = BatchedPredictor(model, params, batch_size=8, greedy=True)
+    rng = np.random.default_rng(0)
+    states = rng.integers(0, 255, (5, *cfg.state_shape)).astype(np.uint8)
+    actions, values, greedy = pred.predict_batch(states)
+    np.testing.assert_array_equal(actions, greedy)
+    assert values.shape == (5,)
+    pred.stop()
+
+
+# -- audit trace stability of continuous batching ---------------------------
+
+
+def test_audit_tripwire_clean_through_serving_run(monkeypatch):
+    """BA3C_AUDIT=1: a serving run through the continuous-batching
+    scheduler — mixed singles, blocks of several sizes, an oversize
+    chunked sync call — must introduce NO trace shape beyond the warmed
+    pow-2 buckets (ISSUE 9 acceptance)."""
+    monkeypatch.setenv("BA3C_AUDIT", "1")
+    from distributed_ba3c_tpu import audit
+
+    cfg, model, params = _real_model_and_params(0)
+    pred = BatchedPredictor(model, params, batch_size=8)
+    pred.warmup(cfg.state_shape)  # compiles buckets 1..8, arms the tripwire
+    tw = audit.live_tripwires()["predict.server"]
+    assert tw.armed
+    served = []
+    done = threading.Event()
+    n_expected = 2 + 3  # 2 blocks + 3 singles
+    rng = np.random.default_rng(1)
+
+    def block_cb(a, v, lp):
+        served.append(len(a))
+        if len(served) == n_expected:
+            done.set()
+
+    def row_cb(a, v, lp):
+        served.append(1)
+        if len(served) == n_expected:
+            done.set()
+
+    pred.start()
+    try:
+        for k in (3, 8):
+            pred.put_block_task(
+                rng.integers(0, 255, (k, *cfg.state_shape)).astype(np.uint8),
+                block_cb,
+            )
+        for _ in range(3):
+            pred.put_task(
+                rng.integers(0, 255, cfg.state_shape).astype(np.uint8), row_cb
+            )
+        assert done.wait(60), (
+            "serving callbacks missing — the scheduler likely died on an "
+            "AuditError retrace"
+        )
+        # oversize sync call: chunked to the warmed bucket, never retraced
+        pred.predict_batch(
+            rng.integers(0, 255, (20, *cfg.state_shape)).astype(np.uint8)
+        )
+        assert pred.threads[0].is_alive()
+        assert tw.armed
+    finally:
+        pred.stop()
+        pred.join(timeout=5)
+
+
+# -- the masters' shed fallback (reply path) --------------------------------
+
+
+class _SheddingPredictor:
+    """Predictor stub that sheds EVERYTHING with a typed reject."""
+
+    num_actions = N_ACTIONS
+
+    def put_block_task(self, states, cb, shed_callback=None, **kw):
+        shed_callback(ShedReject("deadline"))
+        return False
+
+    def put_task(self, state, cb, shed_callback=None, **kw):
+        shed_callback(ShedReject("queue_full"))
+        return False
+
+
+def test_master_shed_fallback_keeps_lockstep_alive(tmp_path):
+    """A shed block reply falls back to uniform-random actions with the
+    TRUE fallback behavior logp (-log A) so the lockstep server keeps
+    stepping and V-trace stays exact."""
+    from distributed_ba3c_tpu.actors.master import BA3CSimulatorMaster
+    from distributed_ba3c_tpu.actors.simulator import BlockClientState
+
+    telemetry.reset_all()
+    master = BA3CSimulatorMaster(
+        f"ipc://{tmp_path}/c2s", f"ipc://{tmp_path}/s2c",
+        _SheddingPredictor(),
+    )
+    try:
+        ident = b"srv-0"
+        master.clients[ident] = BlockClientState(ident, 4)
+        states = np.zeros((4, *STATE), np.uint8)
+        master._on_block_state(states, ident)
+        blk = master.clients[ident]
+        assert len(blk.steps) == 1, "shed fallback did not record the step"
+        step = blk.steps[0]
+        assert ((step.actions >= 0) & (step.actions < N_ACTIONS)).all()
+        np.testing.assert_allclose(step.values, 0.0)
+        np.testing.assert_allclose(step.logps, -np.log(N_ACTIONS), rtol=1e-6)
+        assert master.send_queue.qsize() == 1  # the action reply went out
+        # per-env path too
+        e_ident = b"env-1"
+        master._on_state(np.zeros(STATE, np.uint8), e_ident)
+        assert len(master.clients[e_ident].memory) == 1
+        assert master.send_queue.qsize() == 2
+        scal = telemetry.registry("master").scalars()
+        assert scal["predictor_shed_fallbacks_total"] == 5  # 4 rows + 1
+    finally:
+        master.close()

@@ -17,7 +17,7 @@ from distributed_ba3c_tpu.parallel.vtrace_step import make_vtrace_train_step
 
 
 class _NullPredictor:
-    def put_task(self, state, cb):
+    def put_task(self, state, cb, **kw):
         raise AssertionError("unused")
 
 
