@@ -600,6 +600,100 @@ class UnsupervisedFleetSpawnRule(Rule):
                 )
 
 
+#: queue constructors whose default is UNBOUNDED — in the serving plane an
+#: unbounded queue converts overload into unbounded latency instead of the
+#: fast typed rejection the SLO contract promises (docs/serving.md)
+_QUEUE_CTOR_SUFFIXES = (
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "multiprocessing.Queue", "concurrency.FastQueue",
+)
+_QUEUE_CTOR_BARE = {"Queue", "LifoQueue", "PriorityQueue", "FastQueue"}
+
+_BLOCKING_SLEEPS = {"time.sleep"}
+_CONSOLE_FILE_IO = {"open", "print", "builtins.open", "builtins.print"}
+
+
+class ServingHotPathBlockRule(Rule):
+    """A9: blocking I/O or an unbounded queue inside the serving plane
+    (``predict/``).
+
+    The predictor's scheduler/callback path is the latency budget of every
+    request the serving tier answers (docs/serving.md): a ``time.sleep``,
+    file/console I/O, or a socket op on that path stalls EVERY in-flight
+    request behind it, and an unbounded ``queue.Queue`` turns overload
+    into unbounded queue latency instead of the fast typed rejection the
+    SLO contract promises. Queues in ``predict/`` must be constructed with
+    a positive bound (a computed bound like ``maxsize=queue_depth`` is
+    accepted); waiting must go through bounded-timeout queue ops
+    (``queue_get_stoppable``), never sleeps. The rule applies only to
+    files under a ``predict/`` directory — everywhere else A2/A7 own the
+    neighboring hazards.
+    """
+
+    id = "A9"
+    name = "serving-hot-path-block"
+    summary = "blocking I/O or unbounded queue inside the predict/ serving plane"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if "predict" not in ctx.path.replace(os.sep, "/").split("/"):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.info.resolve(node.func)
+            if resolved and (
+                resolved in _QUEUE_CTOR_BARE
+                or resolved.endswith(_QUEUE_CTOR_SUFFIXES)
+            ):
+                if not self._bounded(node):
+                    yield ctx.finding(
+                        self, node,
+                        "unbounded queue in the serving plane — overload "
+                        "must become fast typed rejection, not unbounded "
+                        "latency: construct with a positive maxsize "
+                        "(docs/serving.md admission contract)",
+                    )
+            elif resolved in _BLOCKING_SLEEPS:
+                yield ctx.finding(
+                    self, node,
+                    "time.sleep on the serving path stalls every in-flight "
+                    "request behind it — wait via bounded-timeout queue ops "
+                    "(queue_get_stoppable) instead",
+                )
+            elif resolved in _CONSOLE_FILE_IO:
+                yield ctx.finding(
+                    self, node,
+                    f"{resolved}() is blocking file/console I/O on the "
+                    "serving path — route diagnostics through telemetry/"
+                    "logger outside predict/",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _WIRE_OPS
+                and _socket_ish(node.func.value)
+            ):
+                yield ctx.finding(
+                    self, node,
+                    f"socket .{node.func.attr}() inside the serving plane — "
+                    "wire I/O belongs to the masters (actors/), the "
+                    "predictor only schedules device calls",
+                )
+
+    @staticmethod
+    def _bounded(call: ast.Call) -> bool:
+        bound = call.args[0] if call.args else None
+        for kw in call.keywords:
+            if kw.arg == "maxsize":
+                bound = kw.value
+        if bound is None:
+            return False
+        if isinstance(bound, ast.Constant):
+            return isinstance(bound.value, int) and bound.value > 0
+        # a computed bound (maxsize=queue_depth) is accepted: the rule
+        # polices the unbounded DEFAULT, not the sizing policy
+        return True
+
+
 ACTOR_RULES = [
     BareThreadRule(),
     BlockingQueueOpRule(),
@@ -609,4 +703,5 @@ ACTOR_RULES = [
     PerEnvWireLoopRule(),
     AdhocMetricRule(),
     UnsupervisedFleetSpawnRule(),
+    ServingHotPathBlockRule(),
 ]
