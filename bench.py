@@ -361,12 +361,26 @@ def make_null_predictor(model, params, n_actions: int, service_s: float = 0.0,
     return _NullDevicePredictor(model, params, **kw)
 
 
-def _master_progress() -> tuple:
-    """(wire messages, datapoints) from the master registry — the plane's
-    provable forward motion, read lock-free off the live counters."""
+def _role_scalars(base: str) -> dict:
+    """Summed counters/gauges over ``base`` AND its per-fleet variants
+    (``master`` + ``master.f0``/``master.f1``/... — telemetry.fleet_role):
+    the bench's progress/attribution reads must see the WHOLE plane, not
+    one fleet of it."""
     from distributed_ba3c_tpu import telemetry
 
-    s = telemetry.registry("master").scalars()
+    out: dict = {}
+    for role, reg in telemetry.all_registries().items():
+        if role != base and not role.startswith(f"{base}.f"):
+            continue
+        for name, v in reg.scalars().items():
+            out[name] = out.get(name, 0.0) + v
+    return out
+
+
+def _master_progress() -> tuple:
+    """(wire messages, datapoints) from the master registries — the plane's
+    provable forward motion, read lock-free off the live counters."""
+    s = _role_scalars("master")
     msgs = (
         s.get("per_env_msgs_total", 0)
         + s.get("block_msgs_total", 0)
@@ -381,8 +395,8 @@ def stall_attribution() -> str:
     scripts/chaos_bench.py attributes its own warmup failures with it."""
     from distributed_ba3c_tpu import telemetry
 
-    m = telemetry.registry("master").scalars()
-    p = telemetry.registry("predictor").scalars()
+    m = _role_scalars("master")
+    p = _role_scalars("predictor")
     msgs, dps = _master_progress()
     depth = m.get("train_queue_depth", 0)
     parts = (
@@ -411,7 +425,7 @@ def bench_zmq_plane(
     game: str = "pong", n_envs: int = 256, seconds: float = 20.0,
     null_device: bool = False, wire: str = "per-env",
     envs_per_proc: int = 32, warmup_datapoints: int = 512,
-    windows: int = 1, telemetry_on: bool = True,
+    windows: int = 1, telemetry_on: bool = True, fleets: int = 1,
 ) -> dict:
     """Actor-plane throughput (BASELINE configs #1/#2): C++ batched env
     servers -> ZMQ -> master -> batched TPU predictor, counting n-step
@@ -430,13 +444,21 @@ def bench_zmq_plane(
     ``wire`` selects the env-server protocol: ``per-env`` (the reference's
     B-messages-per-step shape, the historical 2,128/s ceiling) or ``block``
     (one zero-copy multipart message per server per step,
-    docs/actor_plane.md)."""
+    docs/actor_plane.md).
+
+    ``fleets`` > 1 stands up K INDEPENDENT planes at the SAME per-fleet
+    shape — per-fleet pipes/masters/predictors/telemetry roles, fleet-
+    tagged idents (actors/fleet.py addressing) — and counts the AGGREGATE
+    datapoint rate across their train queues: the device-free proof of the
+    multi-fleet macro-batching scaling claim (``plane_bench --fleets``;
+    ``n_envs``/``envs_per_proc`` stay per-fleet quantities)."""
     import queue
     import tempfile
 
     import numpy as np
 
     from distributed_ba3c_tpu import telemetry
+    from distributed_ba3c_tpu.actors.fleet import fleet_pipes
     from distributed_ba3c_tpu.actors.master import BA3CSimulatorMaster
     from distributed_ba3c_tpu.config import BA3CConfig
     from distributed_ba3c_tpu.envs import native
@@ -463,38 +485,52 @@ def bench_zmq_plane(
     # add latency to the lockstep round trip).
     coalesce_ms = 5.0 if wire == "per-env" else 0.0
     predict_bs = max(cfg.predict_batch_size, envs_per_proc)
-    if null_device:
-        predictor = make_null_predictor(
-            model, params, n_actions,
-            batch_size=predict_bs, num_threads=2,
-            coalesce_ms=coalesce_ms,
-        )
-    else:
-        predictor = BatchedPredictor(
-            model, params, batch_size=predict_bs, num_threads=2,
-            coalesce_ms=coalesce_ms,
-        )
-        predictor.warmup(cfg.state_shape)
     tmp = tempfile.mkdtemp(prefix="ba3c-bench-")
-    c2s, s2c = f"ipc://{tmp}/c2s", f"ipc://{tmp}/s2c"
-    master = BA3CSimulatorMaster(
-        c2s, s2c, predictor,
-        gamma=cfg.gamma, local_time_max=cfg.local_time_max,
-        score_queue=queue.Queue(maxsize=100_000),
-    )
+    base_c2s, base_s2c = f"ipc://{tmp}/c2s", f"ipc://{tmp}/s2c"
     per = envs_per_proc
-    procs = [
-        # the RAW unsupervised plane is the measurand here (no respawn
-        # machinery in the loop); the supervised path has its own
-        # instrument, scripts/chaos_bench.py
-        native.CppEnvServerProcess(  # ba3clint: disable=A8
-            i, c2s, s2c, game=game, n_envs=min(per, n_envs - i * per),
-            wire=wire,
+    predictors, masters, procs = [], [], []
+    for k in range(max(1, fleets)):
+        tag = k if fleets > 1 else None
+        c2s, s2c = fleet_pipes(base_c2s, base_s2c, k)
+        if null_device:
+            predictor = make_null_predictor(
+                model, params, n_actions,
+                batch_size=predict_bs, num_threads=2,
+                coalesce_ms=coalesce_ms,
+                tele_role=telemetry.fleet_role("predictor", tag),
+            )
+        else:
+            predictor = BatchedPredictor(
+                model, params, batch_size=predict_bs, num_threads=2,
+                coalesce_ms=coalesce_ms,
+                tele_role=telemetry.fleet_role("predictor", tag),
+            )
+            predictor.warmup(cfg.state_shape)
+        master = BA3CSimulatorMaster(
+            c2s, s2c, predictor,
+            gamma=cfg.gamma, local_time_max=cfg.local_time_max,
+            score_queue=queue.Queue(maxsize=100_000),
+            tele_role=telemetry.fleet_role("master", tag),
         )
-        for i in range((n_envs + per - 1) // per)
-    ]
-    predictor.start()
-    master.start()
+        predictors.append(predictor)
+        masters.append(master)
+        procs += [
+            # the RAW unsupervised plane is the measurand here (no respawn
+            # machinery in the loop); the supervised path has its own
+            # instrument, scripts/chaos_bench.py
+            native.CppEnvServerProcess(  # ba3clint: disable=A8
+                i, c2s, s2c, game=game, n_envs=min(per, n_envs - i * per),
+                wire=wire,
+                ident_prefix=(
+                    f"f{k}-cppsim-{i}" if fleets > 1 else None
+                ),
+            )
+            for i in range((n_envs + per - 1) // per)
+        ]
+    for predictor in predictors:
+        predictor.start()
+    for master in masters:
+        master.start()
     for p in procs:
         p.start()
     try:
@@ -506,9 +542,13 @@ def bench_zmq_plane(
         # numpy/zmq per process and takes minutes under load
         # (tests/test_native_env.py saw the same)
         try:
-            master.queue.get(timeout=300)
-            for _ in range(warmup_datapoints - 1):
-                master.queue.get(timeout=60)
+            # EVERY fleet must produce before the clock starts (an
+            # aggregate-only warmup would let a dead fleet hide behind a
+            # healthy one and publish a fake per-fleet scaling number)
+            for master in masters:
+                master.queue.get(timeout=300)
+            for _ in range(warmup_datapoints - len(masters)):
+                masters[_ % len(masters)].queue.get(timeout=60)
         except queue.Empty:
             # a bare Empty says "timeout"; the counters say WHICH stage
             # never moved (fleet spawn, predictor serve, flush) — the
@@ -518,7 +558,7 @@ def bench_zmq_plane(
                 f"plane produced no warmup data — {stall_attribution()}"
             ) from None
         window_rates = []
-        q = master.queue
+        qs = [m.queue for m in masters]
         for _ in range(max(1, windows)):
             t0 = time.perf_counter()
             deadline = t0 + seconds
@@ -534,11 +574,20 @@ def bench_zmq_plane(
                 now = time.perf_counter()
                 if now >= deadline:
                     break
-                try:
-                    q.get_nowait()
-                    n += 1
+                drained = 0
+                for q in qs:
+                    # round-robin burst drain across fleets, same fairness
+                    # shape as the FleetMergeFeed collator
+                    try:
+                        while True:
+                            q.get_nowait()
+                            drained += 1
+                    except queue.Empty:
+                        pass
+                if drained:
+                    n += drained
                     empty_since = None
-                except queue.Empty:
+                else:
                     if empty_since is None:
                         empty_since = now
                         stall_mark = _master_progress()[1]
@@ -566,9 +615,12 @@ def bench_zmq_plane(
     finally:
         for p in procs:
             p.terminate()
-        master.close()
-        predictor.stop()
-        predictor.join(timeout=5)
+        for master in masters:
+            master.close()
+        for predictor in predictors:
+            predictor.stop()
+        for predictor in predictors:
+            predictor.join(timeout=5)
         for p in procs:
             p.join(timeout=5)
     rate = max(window_rates)
@@ -584,6 +636,8 @@ def bench_zmq_plane(
         "vs_baseline": round(rate / BASELINE_ENV_STEPS_PER_SEC, 3),
         "predictor": "null-host-random" if null_device else "batched-tpu",
         "wire": wire,
+        "fleets": max(1, fleets),
+        # per-fleet shape (the unit the --fleets scaling gate compares at)
         "n_envs": n_envs,
         "envs_per_proc": per,
         "seconds": seconds,

@@ -45,10 +45,11 @@ class BA3CSimulatorMaster(SimulatorMaster):
         score_queue: Optional[queue.Queue] = None,
         actor_timeout: Optional[float] = None,
         reward_clip: float = 0.0,
+        tele_role: str = "master",
     ):
         super().__init__(
             pipe_c2s, pipe_s2c, actor_timeout=actor_timeout,
-            reward_clip=reward_clip,
+            reward_clip=reward_clip, tele_role=tele_role,
         )
         self.predictor = predictor
         self.gamma = gamma

@@ -282,13 +282,20 @@ class SimulatorMaster(threading.Thread):
         pipe_s2c: str,
         actor_timeout: Optional[float] = None,
         reward_clip: float = 0.0,
+        tele_role: str = "master",
     ):
         """``actor_timeout``: seconds of silence after which a client's state
         is dropped (failure detection the reference lacked, SURVEY.md §5 —
         a dead simulator would otherwise pin its half-built rollout forever).
         None disables pruning. ``reward_clip``: clip the LEARNING reward to
-        [-c, c] (0 = off); episode scores always accumulate raw rewards."""
-        super().__init__(daemon=True, name="SimulatorMaster")
+        [-c, c] (0 = off); episode scores always accumulate raw rewards.
+        ``tele_role``: this master's telemetry identity — ``master`` for a
+        single-fleet run, ``telemetry.fleet_role("master", k)`` when a
+        learner hosts several fleets side by side (each master must own its
+        counters/gauges, or K masters' series collapse into one registry
+        and every per-fleet signal — autoscaler fill fractions included —
+        reads the fleet SUM)."""
+        super().__init__(daemon=True, name=f"SimulatorMaster-{tele_role}")
         self.actor_timeout = actor_timeout
         assert reward_clip >= 0, (
             f"reward_clip must be >= 0, got {reward_clip} (a negative bound "
@@ -333,7 +340,15 @@ class SimulatorMaster(threading.Thread):
         # here and kept as attributes so the hot path pays a dict-get per
         # BATCH, never a registry lookup. Gauges bind weakly — the registry
         # outlives any one master and must not pin a closed one alive.
-        tele = telemetry.registry("master")
+        self.tele_role = tele_role
+        # env-server piggyback deltas fold into the matching fleet role
+        # (``fleet`` <-> ``master``, ``fleet.f<k>`` <-> ``master.f<k>``):
+        # per-fleet senders must not merge into one aggregate registry
+        self._fleet_tele_role = (
+            "fleet" if tele_role == "master"
+            else tele_role.replace("master", "fleet", 1)
+        )
+        tele = telemetry.registry(tele_role)
         self._flight = telemetry.flight_recorder()
         self._c_per_env_msgs = tele.counter("per_env_msgs_total")
         self._c_block_msgs = tele.counter("block_msgs_total")
@@ -444,7 +459,9 @@ class SimulatorMaster(threading.Thread):
                         # length-versioned header: element 5 is the sender's
                         # piggybacked metric deltas (telemetry/wire.py);
                         # plain 4-element messages parse as before
-                        telemetry.apply_fleet_deltas(ident, msg[4])
+                        telemetry.apply_fleet_deltas(
+                            ident, msg[4], role=self._fleet_tele_role
+                        )
                     self._c_per_env_msgs.inc()
                     client = self.clients[ident]
                     client.ident = ident
@@ -597,7 +614,9 @@ class SimulatorMaster(threading.Thread):
                 # length-versioned header: the last element is the server's
                 # piggybacked metric deltas (telemetry/wire.py); old
                 # base-length headers parse exactly as before
-                telemetry.apply_fleet_deltas(ident, meta[base_meta_len])
+                telemetry.apply_fleet_deltas(
+                    ident, meta[base_meta_len], role=self._fleet_tele_role
+                )
         except (ValueError, TypeError, IndexError) as e:
             # wire input is untrusted: a version-mismatched fleet (or any
             # stray sender on the bound port) must not kill the receive

@@ -16,8 +16,15 @@ number lives on:
 The registered entry points (one per hot-path jit site):
 
     parallel.train_step   the sync DP step      (parallel/train_step.py)
+    parallel.train_macro_step
+                          the multi-fleet macro step: K fleet sub-batches
+                          (fleet axis sharded over data), one update
     parallel.vtrace_step  the V-trace step      (parallel/vtrace_step.py)
+    parallel.vtrace_macro_step
+                          the V-trace macro step (same fleet-major layout)
     fused.step            the fused rollout+update step (fused/loop.py)
+    fused.macro_learner   the overlap macro learner: K trajectory blocks
+                          accumulated into one update (fused/overlap.py)
     fused.actor           the overlap rollout program (fused/overlap.py) —
                           donation-aliased env carry, collective-free
     fused.learner         the overlap V-trace learner (fused/overlap.py)
@@ -378,6 +385,66 @@ def _build_vtrace_step() -> TraceTarget:
     )
 
 
+@register_entry("parallel.train_macro_step")
+def _build_train_macro_step() -> TraceTarget:
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ba3c_tpu.parallel.train_step import make_macro_train_step
+
+    cfg, model, opt = _canonical_parts()
+    mesh = canonical_mesh()
+    # canonical macro shape: K=4 fleets over the 2-device mesh — 2 fleets
+    # per shard, so the sequential accumulation scan is IN the program
+    # (K == D would compile the scan away and pin the wrong structure)
+    K, B = 4, 16
+    step = make_macro_train_step(model, opt, cfg, mesh, n_fleets=K)
+    state = _state_avals(model, cfg, opt)
+    batch = {
+        "state": jax.ShapeDtypeStruct((K, B, *cfg.state_shape), jnp.uint8),
+        "action": jax.ShapeDtypeStruct((K, B), jnp.int32),
+        "return": jax.ShapeDtypeStruct((K, B), jnp.float32),
+    }
+    return TraceTarget(
+        name="parallel.train_macro_step",
+        jit_fn=step.audit_jit,
+        args=(state, batch, _scalar(jnp.float32), _scalar(jnp.float32)),
+        grad_shapes=_grad_shapes(state.params),
+        donated_nonscalar_indices=_donated_indices(state),
+    )
+
+
+@register_entry("parallel.vtrace_macro_step")
+def _build_vtrace_macro_step() -> TraceTarget:
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ba3c_tpu.parallel.vtrace_step import make_vtrace_macro_step
+
+    cfg, model, opt = _canonical_parts()
+    mesh = canonical_mesh()
+    # K=4 over D=2 for the same in-program-scan reason as the BA3C macro
+    K, T, B = 4, 4, 8
+    step = make_vtrace_macro_step(model, opt, cfg, mesh, n_fleets=K)
+    state = _state_avals(model, cfg, opt)
+    sds = jax.ShapeDtypeStruct
+    batch = {
+        "state": sds((K, T, B, *cfg.state_shape), jnp.uint8),
+        "action": sds((K, T, B), jnp.int32),
+        "reward": sds((K, T, B), jnp.float32),
+        "done": sds((K, T, B), jnp.float32),
+        "behavior_log_probs": sds((K, T, B), jnp.float32),
+        "bootstrap_state": sds((K, B, *cfg.state_shape), jnp.uint8),
+    }
+    return TraceTarget(
+        name="parallel.vtrace_macro_step",
+        jit_fn=step.audit_jit,
+        args=(state, batch, _scalar(jnp.float32), _scalar(jnp.float32)),
+        grad_shapes=_grad_shapes(state.params),
+        donated_nonscalar_indices=_donated_indices(state),
+    )
+
+
 @register_entry("fused.step")
 def _build_fused_step() -> TraceTarget:
     import jax
@@ -492,6 +559,43 @@ def _build_overlap_learner() -> TraceTarget:
         # only the train state is donated — the block must stay live (it
         # is the double-buffer slot the actor wrote; no learner output
         # matches its shapes, so an alias is impossible anyway)
+        donated_nonscalar_indices=_donated_indices(train),
+    )
+
+
+@register_entry("fused.macro_learner")
+def _build_overlap_macro_learner() -> TraceTarget:
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ba3c_tpu.envs.jaxenv import pong
+    from distributed_ba3c_tpu.fused.overlap import TrajBlock, make_overlap_step
+
+    cfg, model, opt = _canonical_parts()
+    mesh = canonical_mesh()
+    K = 2  # canonical macro window count (the accumulation scan is per-shard)
+    step = make_overlap_step(
+        model, opt, cfg, mesh, pong, rollout_len=4, macro_fleets=K
+    )
+    train = _state_avals(model, cfg, opt)
+    T, B = 4, 2 * CANONICAL_MESH_DEVICES  # one canonical actor block each
+    sds = jax.ShapeDtypeStruct
+    block = TrajBlock(
+        states=sds((T, B, *cfg.state_shape), jnp.uint8),
+        actions=sds((T, B), jnp.int32),
+        rewards=sds((T, B), jnp.float32),
+        dones=sds((T, B), jnp.float32),
+        behavior_log_probs=sds((T, B), jnp.float32),
+        behavior_values=sds((T, B), jnp.float32),
+        bootstrap_state=sds((B, *cfg.state_shape), jnp.uint8),
+    )
+    return TraceTarget(
+        name="fused.macro_learner",
+        jit_fn=step.macro_learner_jit,
+        args=(train, (block,) * K, _scalar(jnp.float32), _scalar(jnp.float32)),
+        grad_shapes=_grad_shapes(train.params),
+        # only the train state is donated — the K blocks are the actor's
+        # double-buffer slots, same non-donation contract as fused.learner
         donated_nonscalar_indices=_donated_indices(train),
     )
 

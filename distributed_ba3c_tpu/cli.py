@@ -95,6 +95,28 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--anneal", default="linear", choices=["linear", "exp"], help="shape of the *_final anneals: linear or geometric (exp)")
     p.add_argument("--anneal_lr", default=None, choices=["linear", "exp"], help="override --anneal for learning_rate only (β and lr want different shapes: β drops early, lr holds through the mid-game)")
     p.add_argument("--anneal_beta", default=None, choices=["linear", "exp"], help="override --anneal for entropy_beta only")
+    # -- multi-fleet macro-batching (docs/actor_plane.md) ------------------
+    p.add_argument(
+        "--fleets", type=int, default=1,
+        help="N independent actor fleets feeding this learner (ZMQ-plane "
+        "trainers, train task): each fleet gets its own pipe pair (derived "
+        "from --pipe_c2s/--pipe_s2c or the ipc defaults), master, "
+        "predictor, supervisor and telemetry identity (master.f<k>...); "
+        "per-fleet queues merge through a fair round-robin collator and "
+        "the learner runs the gradient-accumulation MACRO step — N "
+        "full-recipe sub-batches, one update, fleet axis sharded over "
+        "chips so every chip steps at its full-occupancy batch "
+        "(docs/actor_plane.md). --simulator_procs is the TOTAL across "
+        "fleets and must divide evenly",
+    )
+    p.add_argument(
+        "--fleet_accum", type=int, default=1,
+        help="fused --overlap only: rollout windows accumulated per "
+        "update via the fused.macro_learner program — the fused half of "
+        "multi-fleet macro-batching (per-update effective batch grows "
+        "K-fold at unchanged per-window occupancy; V-trace corrects the "
+        "1..K-update behavior lag)",
+    )
     # -- elastic fleet orchestration (docs/orchestration.md) ---------------
     p.add_argument(
         "--fleet_min", type=int, default=0,
@@ -291,6 +313,26 @@ def main(argv: Optional[list] = None) -> int:
             "requires --trainer tpu_fused_ba3c (the ZMQ trainers already "
             "overlap actors and learner across processes)"
         )
+    if args.fleets < 1:
+        raise SystemExit(f"--fleets must be >= 1, got {args.fleets}")
+    if args.fleets > 1 and (
+        args.task != "train" or args.trainer == "tpu_fused_ba3c"
+    ):
+        raise SystemExit(
+            "--fleets N runs N actor fleets against the ZMQ-plane "
+            "trainers' train task — the fused trainer has no actor plane "
+            "(its macro-batching knob is --fleet_accum with --overlap), "
+            "and eval/play spawn no fleet"
+        )
+    if args.fleet_accum < 1:
+        raise SystemExit(f"--fleet_accum must be >= 1, got {args.fleet_accum}")
+    if args.fleet_accum > 1 and not args.overlap:
+        raise SystemExit(
+            "--fleet_accum accumulates rollout windows in the overlap "
+            "trainer's macro learner — it requires --trainer "
+            "tpu_fused_ba3c --overlap (ZMQ-plane macro-batching is "
+            "--fleets N)"
+        )
     # serving-plane flags belong to the predictor path; a fused run has no
     # predictor, and a half-specified canary is a config typo — usage
     # errors, never silently-ignored modifiers (repo convention)
@@ -432,8 +474,18 @@ def main(argv: Optional[list] = None) -> int:
         default_pipes,
     )
     from distributed_ba3c_tpu.actors.vtrace_master import VTraceSimulatorMaster
-    from distributed_ba3c_tpu.data.dataflow import RolloutFeed, TrainFeed
-    from distributed_ba3c_tpu.parallel.vtrace_step import make_vtrace_train_step
+    from distributed_ba3c_tpu.data.dataflow import (
+        FleetMergeFeed,
+        RolloutFeed,
+        TrainFeed,
+        collate_rollout,
+        collate_train,
+    )
+    from distributed_ba3c_tpu.parallel.train_step import make_macro_train_step
+    from distributed_ba3c_tpu.parallel.vtrace_step import (
+        make_vtrace_macro_step,
+        make_vtrace_train_step,
+    )
     from distributed_ba3c_tpu.predict.server import BatchedPredictor
     from distributed_ba3c_tpu.train.callbacks import (
         Evaluator,
@@ -467,35 +519,10 @@ def main(argv: Optional[list] = None) -> int:
             stuck_limit=30,
             stuck_action=1,
         )
-    predictor = BatchedPredictor(
-        model,
-        state.params,
-        batch_size=cfg.predict_batch_size,
-        num_threads=cfg.predictor_threads,
-        slo_ms=args.serve_slo_ms,
-    )
-    # multi-policy serving (docs/serving.md): canary/shadow checkpoints
-    # are pinned policies behind the same scheduler — the learner's
-    # update_params publishes only touch 'default'
-    if args.canary_load or args.shadow_load:
-        from distributed_ba3c_tpu.train.checkpoint import CheckpointManager
-
-        def _policy_params(ckpt_dir):
-            return CheckpointManager(ckpt_dir).restore(
-                jax.device_get(state)
-            ).params
-
-        if args.canary_load:
-            predictor.add_policy("canary", _policy_params(args.canary_load))
-            predictor.set_canary("canary", args.canary_fraction)
-        if args.shadow_load:
-            predictor.add_policy("shadow", _policy_params(args.shadow_load))
-            predictor.set_shadow("shadow")
-    # precompile every serving bucket now — a first-time bucket compile
-    # mid-training stalls the whole actor plane for tens of seconds
-    predictor.warmup(cfg.state_shape)
     # explicit pipe addresses (tcp:// for cross-host fleets) override the
-    # per-pid ipc:// defaults; the master BINDS, env servers connect
+    # per-pid ipc:// defaults; the master BINDS, env servers connect.
+    # --fleets > 1 derives per-fleet pairs from this base (actors/fleet.py
+    # fleet_pipes: fleet 0 keeps it verbatim)
     if args.pipe_c2s and args.pipe_s2c:
         c2s, s2c = args.pipe_c2s, args.pipe_s2c
     elif args.pipe_c2s or args.pipe_s2c:
@@ -505,48 +532,144 @@ def main(argv: Optional[list] = None) -> int:
     score_q: queue.Queue = queue.Queue(maxsize=4096)
     n_data = mesh.shape["data"]
     n_hosts = jax.process_count()
+    n_fleets = args.fleets
+    multi_fleet = n_fleets > 1
+    if multi_fleet and distributed:
+        raise SystemExit(
+            "--fleets > 1 runs N fleets behind ONE single-host learner — "
+            "for multi-host deployments run one learner (with its fleets) "
+            "per host, or use --worker_hosts with --fleets 1"
+        )
+    if multi_fleet and n_fleets % n_data:
+        raise SystemExit(
+            f"--fleets {n_fleets} must be divisible by the mesh data axis "
+            f"({n_data}): the macro step assigns whole fleets to chips — "
+            "set --mesh_data to a divisor of --fleets"
+        )
+    if multi_fleet and cfg.simulator_procs % n_fleets:
+        raise SystemExit(
+            f"--simulator_procs {cfg.simulator_procs} must split evenly "
+            f"across --fleets {n_fleets}"
+        )
+
+    # per-fleet predictor factory: every fleet serves the same policy
+    # table (canary/shadow included), each behind its own scheduler
+    from distributed_ba3c_tpu.actors.fleet import (
+        FanoutPredictors,
+        build_fleet_planes,
+    )
+
+    _policy_extras = []
+    if args.canary_load or args.shadow_load:
+        from distributed_ba3c_tpu.train.checkpoint import CheckpointManager
+
+        def _policy_params(ckpt_dir):
+            return CheckpointManager(ckpt_dir).restore(
+                jax.device_get(state)
+            ).params
+
+        if args.canary_load:
+            _policy_extras.append(
+                ("canary", _policy_params(args.canary_load),
+                 args.canary_fraction)
+            )
+        if args.shadow_load:
+            _policy_extras.append(
+                ("shadow", _policy_params(args.shadow_load), None)
+            )
+
+    def make_predictor(k: int, tele_role: str):
+        pred = BatchedPredictor(
+            model,
+            state.params,
+            batch_size=cfg.predict_batch_size,
+            num_threads=cfg.predictor_threads,
+            slo_ms=args.serve_slo_ms,
+            tele_role=tele_role,
+        )
+        # multi-policy serving (docs/serving.md): canary/shadow checkpoints
+        # are pinned policies behind the same scheduler — the learner's
+        # update_params publishes only touch 'default'
+        for name, params_k, fraction in _policy_extras:
+            pred.add_policy(name, params_k)
+            if name == "canary":
+                pred.set_canary("canary", fraction)
+            else:
+                pred.set_shadow("shadow")
+        # precompile every serving bucket now — a first-time bucket compile
+        # mid-training stalls the whole actor plane for tens of seconds
+        pred.warmup(cfg.state_shape)
+        return pred
+
     if args.trainer == "tpu_vtrace_ba3c":
-        step = make_vtrace_train_step(model, optimizer, cfg, mesh)
-        master = VTraceSimulatorMaster(
-            c2s,
-            s2c,
-            predictor,
-            unroll_len=cfg.local_time_max,
-            score_queue=score_q,
-            actor_timeout=args.actor_timeout or None,
-            reward_clip=cfg.reward_clip,
-        )
-        # segments per GLOBAL batch: ~batch_size transitions, divisible by
-        # the data axis; each host's feed collates only its 1/n_hosts share
-        n_seg = max(1, cfg.batch_size // cfg.local_time_max)
-        n_seg = max(n_data, (n_seg // n_data) * n_data)
-        assert n_seg % n_hosts == 0, (n_seg, n_hosts)
-        feed = RolloutFeed(master.queue, n_seg // n_hosts)
-        # ring-safety input: the feed's collate holder pins ring views too
-        master.feed_batch = n_seg // n_hosts
-        samples_per_step = n_seg * cfg.local_time_max
+        # segments per fleet sub-batch: ~batch_size transitions. Single
+        # fleet keeps the data-axis rounding (segment axis shards over
+        # chips); multi-fleet needs none — the FLEET axis shards, and each
+        # chip runs whole full-recipe sub-batches (macro-batching)
+        if multi_fleet:
+            step = make_vtrace_macro_step(
+                model, optimizer, cfg, mesh, n_fleets=n_fleets
+            )
+            n_seg = max(1, cfg.batch_size // cfg.local_time_max)
+        else:
+            step = make_vtrace_train_step(model, optimizer, cfg, mesh)
+            n_seg = max(1, cfg.batch_size // cfg.local_time_max)
+            n_seg = max(n_data, (n_seg // n_data) * n_data)
+            assert n_seg % n_hosts == 0, (n_seg, n_hosts)
+        per_fleet_items = n_seg // n_hosts
+        samples_per_step = n_fleets * n_seg * cfg.local_time_max
+
+        def make_master(k, c2s_k, s2c_k, pred, tele_role):
+            m = VTraceSimulatorMaster(
+                c2s_k,
+                s2c_k,
+                pred,
+                unroll_len=cfg.local_time_max,
+                score_queue=score_q,
+                actor_timeout=args.actor_timeout or None,
+                reward_clip=cfg.reward_clip,
+                tele_role=tele_role,
+            )
+            # ring-safety input: the feed's per-fleet collate holder pins
+            # ring views too
+            m.feed_batch = per_fleet_items
+            return m
+
     else:
-        step = make_train_step(model, optimizer, cfg, mesh)
-        master = BA3CSimulatorMaster(
-            c2s,
-            s2c,
-            predictor,
-            gamma=cfg.gamma,
-            local_time_max=cfg.local_time_max,
-            score_queue=score_q,
-            actor_timeout=args.actor_timeout or None,
-            reward_clip=cfg.reward_clip,
-        )
-        if distributed:
-            local_batch_slice(cfg.batch_size)  # asserts host divisibility
-        feed = TrainFeed(master.queue, cfg.batch_size // n_hosts)
-        # ring-safety input: the feed's collate holder pins ring views too
-        master.feed_batch = cfg.batch_size // n_hosts
-        samples_per_step = cfg.batch_size
+        if multi_fleet:
+            step = make_macro_train_step(
+                model, optimizer, cfg, mesh, n_fleets=n_fleets
+            )
+        else:
+            step = make_train_step(model, optimizer, cfg, mesh)
+            if distributed:
+                local_batch_slice(cfg.batch_size)  # asserts host divisibility
+        per_fleet_items = cfg.batch_size // n_hosts
+        samples_per_step = n_fleets * cfg.batch_size
+
+        def make_master(k, c2s_k, s2c_k, pred, tele_role):
+            m = BA3CSimulatorMaster(
+                c2s_k,
+                s2c_k,
+                pred,
+                gamma=cfg.gamma,
+                local_time_max=cfg.local_time_max,
+                score_queue=score_q,
+                actor_timeout=args.actor_timeout or None,
+                reward_clip=cfg.reward_clip,
+                tele_role=tele_role,
+            )
+            # ring-safety input: the feed's per-fleet collate holder pins
+            # ring views too
+            m.feed_batch = per_fleet_items
+            return m
+
     # Local fleets are owned by a FleetSupervisor (docs/orchestration.md):
     # crashed/wedged servers respawn with backoff behind a restart-budget
     # circuit breaker, stale shm rings are reclaimed at spawn, and
-    # --fleet_min/--fleet_max attach the telemetry-driven autoscaler.
+    # --fleet_min/--fleet_max attach the telemetry-driven autoscaler
+    # (PER-FLEET bounds when --fleets > 1 — each fleet gets its own
+    # supervisor + policy loop over its own master's signals).
     from distributed_ba3c_tpu.orchestrate import (
         Autoscaler,
         FleetSpec,
@@ -561,18 +684,27 @@ def main(argv: Optional[list] = None) -> int:
             raise SystemExit(
                 f"launch fleet size {n_servers} servers is outside "
                 f"[--fleet_min {lo}, --fleet_max {hi}] — size the launch "
-                "fleet (--simulator_procs) inside the bounds"
+                "fleet (--simulator_procs, split per fleet) inside the "
+                "bounds"
             )
         return lo, hi
 
-    supervisor = None
+    def _maybe_autoscaler(supervisor, m):
+        if supervisor.spec.fleet_max > supervisor.spec.fleet_min:
+            # elastic bounds requested: the policy loop watches THIS
+            # fleet's master backpressure signals (never its own heartbeats)
+            return Autoscaler(
+                supervisor,
+                master_signals(m),
+                interval_s=args.autoscale_interval,
+            )
+        return None
+
+    make_supervision = None
     if external_fleet:
         # remote fleets own the envs; nothing to start (or supervise)
         # locally — scripts/launch_env_fleet.py supervises on its host
-        logger.info(
-            "external-fleet mode: master pipes bound at %s (c2s) / %s (s2c) "
-            "— waiting for env servers to connect", c2s, s2c,
-        )
+        pass
     elif args.env.startswith("cpp:"):
         # batched native servers: each process hosts up to 16 envs in lockstep
         from distributed_ba3c_tpu.envs import native
@@ -583,7 +715,7 @@ def main(argv: Optional[list] = None) -> int:
             from distributed_ba3c_tpu.utils import shm
 
             wire = "block-shm" if shm.available() else "block"
-        total = cfg.simulator_procs
+        total = cfg.simulator_procs // n_fleets  # envs per fleet
         per = min(16, total)
         if wire != "per-env" and per > cfg.predict_batch_size:
             # fail at startup, not as an exception inside the master's
@@ -594,11 +726,12 @@ def main(argv: Optional[list] = None) -> int:
                 "serves a whole block in one predictor call — raise "
                 f"--predict_batch_size to >= {per} or use --wire per-env"
             )
-        def ring_cap(b: int):
+
+        def ring_cap(m, b: int):
             # size each server's shm ring for THIS run's actual buffering
             # (queue + feed holder + flush horizon) so the master's check
             # never refuses a config the defaults could have sized for;
-            # 25% headroom. Every input is read off the master object and
+            # 25% headroom. Every input is read off the fleet's master and
             # fed to the SAME utils/shm.py formula the master's attach-time
             # check uses — sizing and refusal cannot drift
             if wire != "block-shm":
@@ -607,12 +740,12 @@ def main(argv: Optional[list] = None) -> int:
 
             need = min_safe_cap(
                 b,
-                int(getattr(master.queue, "maxsize", 0)),
-                int(getattr(master, "feed_batch", 0)),
-                int(getattr(master, "ring_steps_per_item", 1)),
+                int(getattr(m.queue, "maxsize", 0)),
+                int(getattr(m, "feed_batch", 0)),
+                int(getattr(m, "ring_steps_per_item", 1)),
                 int(
-                    getattr(master, "local_time_max", 0)
-                    or getattr(master, "unroll_len", 0)
+                    getattr(m, "local_time_max", 0)
+                    or getattr(m, "unroll_len", 0)
                 ),
                 cfg.frame_history,
             )
@@ -625,49 +758,105 @@ def main(argv: Optional[list] = None) -> int:
         n_servers = (total + per - 1) // per
         lo, hi = _fleet_bounds(n_servers)
 
-        def cpp_factory(i):
-            # ragged last INITIAL slot keeps --simulator_procs exact;
-            # slots grown past it host the full block. Ring caps are
-            # sized per-slot from the run's actual buffering (above).
-            n = per
-            remaining = total - i * per
-            if 0 < remaining < n:
-                n = remaining
-            # construction only parameterizes the slot — the
-            # FleetSupervisor this factory is handed to owns the spawn
-            return native.CppEnvServerProcess(  # ba3clint: disable=A8
-                i,
-                c2s,
-                s2c,
-                game=game,
-                n_envs=n,
-                frame_history=cfg.frame_history,
-                wire=wire,
-                shm_ring_cap=ring_cap(n),
-            )
+        def make_supervision(k, c2s_k, s2c_k, m):
+            # fleet-tagged ident prefixes keep the telemetry sender table
+            # (and prune-event slot mapping) distinct across fleets; ring
+            # names namespace themselves through the per-fleet c2s hash
+            # (utils/shm.py ring_name)
+            def prefix(i):
+                return (
+                    f"f{k}-cppsim-{i}" if multi_fleet else f"cppsim-{i}"
+                )
 
-        supervisor = FleetSupervisor(
-            FleetSpec(
-                pipe_c2s=c2s, pipe_s2c=s2c, game=game, envs_per_server=per,
-                frame_history=cfg.frame_history, wire=wire,
-                fleet_size=n_servers, fleet_min=lo, fleet_max=hi,
-            ),
-            factory=cpp_factory,
-        )
+            def cpp_factory(i):
+                # ragged last INITIAL slot keeps the per-fleet env count
+                # exact; slots grown past it host the full block. Ring
+                # caps are sized per-slot from the run's actual buffering.
+                n = per
+                remaining = total - i * per
+                if 0 < remaining < n:
+                    n = remaining
+                # construction only parameterizes the slot — the
+                # FleetSupervisor this factory is handed to owns the spawn
+                return native.CppEnvServerProcess(  # ba3clint: disable=A8
+                    i,
+                    c2s_k,
+                    s2c_k,
+                    game=game,
+                    n_envs=n,
+                    frame_history=cfg.frame_history,
+                    wire=wire,
+                    shm_ring_cap=ring_cap(m, n),
+                    ident_prefix=prefix(i),
+                )
+
+            sup = FleetSupervisor(
+                FleetSpec(
+                    pipe_c2s=c2s_k, pipe_s2c=s2c_k, game=game,
+                    envs_per_server=per, frame_history=cfg.frame_history,
+                    wire=wire, fleet_size=n_servers, fleet_min=lo,
+                    fleet_max=hi,
+                ),
+                factory=cpp_factory,
+                ident_prefix=prefix,
+            )
+            return sup, _maybe_autoscaler(sup, m)
+
     else:
-        lo, hi = _fleet_bounds(cfg.simulator_procs)
-        supervisor = FleetSupervisor(
-            FleetSpec(
-                pipe_c2s=c2s, pipe_s2c=s2c, envs_per_server=1,
-                frame_history=cfg.frame_history, wire="per-env",
-                fleet_size=cfg.simulator_procs, fleet_min=lo, fleet_max=hi,
+        per_fleet_sims = cfg.simulator_procs // n_fleets
+        lo, hi = _fleet_bounds(per_fleet_sims)
+
+        def make_supervision(k, c2s_k, s2c_k, m):
+            # per-fleet global index stride keeps python-simulator idents
+            # ("simulator-<idx>") distinct across fleets — SimulatorProcess
+            # derives its wire ident from idx alone
+            base = k * 10000
+
+            sup = FleetSupervisor(
+                FleetSpec(
+                    pipe_c2s=c2s_k, pipe_s2c=s2c_k, envs_per_server=1,
+                    frame_history=cfg.frame_history, wire="per-env",
+                    fleet_size=per_fleet_sims, fleet_min=lo, fleet_max=hi,
+                ),
+                # same parameterize-only contract as cpp_factory above
+                factory=lambda i: SimulatorProcess(  # ba3clint: disable=A8
+                    base + i, c2s_k, s2c_k, sim_build_player
+                ),
+                ident_prefix=lambda i: f"simulator-{base + i}",
+            )
+            return sup, _maybe_autoscaler(sup, m)
+
+    planes = build_fleet_planes(  # ba3clint: disable=A8 — factories above only parameterize; each fleet's FleetSupervisor owns its spawns
+        n_fleets, c2s, s2c, make_predictor, make_master, make_supervision
+    )
+    if external_fleet:
+        for pl in planes:
+            logger.info(
+                "external-fleet mode (fleet %d): master pipes bound at %s "
+                "(c2s) / %s (s2c) — waiting for env servers to connect",
+                pl.fleet, pl.pipe_c2s, pl.pipe_s2c,
+            )
+    masters = [pl.master for pl in planes]
+    if multi_fleet:
+        # fair round-robin merge of the per-fleet queues into stacked
+        # [K, ...] macro batches (data/dataflow.py) — the layout the macro
+        # step shards fleet-major over the mesh
+        feed = FleetMergeFeed(
+            [m.queue for m in masters],
+            per_fleet_items,
+            collate=(
+                collate_rollout
+                if args.trainer == "tpu_vtrace_ba3c"
+                else collate_train
             ),
-            # same parameterize-only contract as cpp_factory above
-            factory=lambda i: SimulatorProcess(  # ba3clint: disable=A8
-                i, c2s, s2c, sim_build_player
-            ),
-            ident_prefix=lambda i: f"simulator-{i}",
         )
+        predictor = FanoutPredictors([pl.predictor for pl in planes])
+    else:
+        if args.trainer == "tpu_vtrace_ba3c":
+            feed = RolloutFeed(masters[0].queue, per_fleet_items)
+        else:
+            feed = TrainFeed(masters[0].queue, per_fleet_items)
+        predictor = planes[0].predictor
 
     # Order matters: Evaluator adds its stats BEFORE StatPrinter finalizes the
     # epoch record, and MaxSaver reads the monitored stat from that record.
@@ -691,19 +880,14 @@ def main(argv: Optional[list] = None) -> int:
         if args.telemetry_port
         else []
     )
-    startables = [predictor, master, feed]
-    if supervisor is not None:
-        startables.append(supervisor)
-        if supervisor.spec.fleet_max > supervisor.spec.fleet_min:
-            # elastic bounds requested: the policy loop watches THIS
-            # master's backpressure signals (never its own heartbeats)
-            startables.append(
-                Autoscaler(
-                    supervisor,
-                    master_signals(master),
-                    interval_s=args.autoscale_interval,
-                )
-            )
+    # start order: every fleet's predictor+master, then the merge feed,
+    # then supervisors/autoscalers (spawning servers before their master's
+    # receive loop is live would park the whole fleet in its first recv)
+    startables = [pl.predictor for pl in planes]
+    startables += masters
+    startables.append(feed)
+    startables += [pl.supervisor for pl in planes if pl.supervisor is not None]
+    startables += [pl.autoscaler for pl in planes if pl.autoscaler is not None]
     callbacks = [
         StartProcOrThread(startables + tele_servers),
         HumanHyperParamSetter("learning_rate", shared_dir=base_logdir),

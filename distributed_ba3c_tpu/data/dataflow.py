@@ -12,8 +12,9 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from abc import ABC, abstractmethod
-from typing import Dict, Iterator, List, Optional
+from typing import Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -133,15 +134,35 @@ class _BatchFeed:
         return self._out.qsize()
 
 
+def collate_train(holder: List[list]) -> Dict[str, np.ndarray]:
+    """[state, action, R] datapoints → flat {state, action, return} arrays
+    (THE collate both :class:`TrainFeed` and the multi-fleet merge use —
+    one definition, or the two streams' batch layouts could drift)."""
+    return {
+        "state": np.stack([dp[0] for dp in holder]),
+        "action": np.asarray([dp[1] for dp in holder], np.int32),
+        "return": np.asarray([dp[2] for dp in holder], np.float32),
+    }
+
+
+def collate_rollout(holder: List[dict]) -> Dict[str, np.ndarray]:
+    """V-trace segment dicts → time-major [T, B] arrays (shared by
+    :class:`RolloutFeed` and the multi-fleet merge, like collate_train)."""
+    batch = {}
+    for k in ("state", "action", "reward", "done", "behavior_log_probs"):
+        stacked = np.stack([seg[k] for seg in holder], axis=0)  # [B,T,...]
+        batch[k] = np.swapaxes(stacked, 0, 1).copy()  # [T,B,...]
+    batch["bootstrap_state"] = np.stack(
+        [seg["bootstrap_state"] for seg in holder]
+    )
+    return batch
+
+
 class TrainFeed(_BatchFeed):
     """[state, action, R] datapoints → flat {state, action, return} batches."""
 
     def _collate(self, holder: List[list]) -> Dict[str, np.ndarray]:
-        return {
-            "state": np.stack([dp[0] for dp in holder]),
-            "action": np.asarray([dp[1] for dp in holder], np.int32),
-            "return": np.asarray([dp[2] for dp in holder], np.float32),
-        }
+        return collate_train(holder)
 
 
 class RolloutFeed(_BatchFeed):
@@ -153,11 +174,118 @@ class RolloutFeed(_BatchFeed):
     """
 
     def _collate(self, holder: List[dict]) -> Dict[str, np.ndarray]:
-        batch = {}
-        for k in ("state", "action", "reward", "done", "behavior_log_probs"):
-            stacked = np.stack([seg[k] for seg in holder], axis=0)  # [B,T,...]
-            batch[k] = np.swapaxes(stacked, 0, 1).copy()  # [T,B,...]
-        batch["bootstrap_state"] = np.stack(
-            [seg["bootstrap_state"] for seg in holder]
+        return collate_rollout(holder)
+
+
+class FleetMergeFeed:
+    """K per-fleet queues → one merged train stream (docs/actor_plane.md).
+
+    The multi-fleet macro-batching collator: each fleet's master emits into
+    its own (Fast)queue, and this feed drains all K with a FAIR ROUND-ROBIN
+    — at most one item per fleet per pass, skipping empty queues — into
+    per-fleet holders. Fairness is what keeps one slow fleet from wedging
+    the drain order (the fast fleets' queues keep emptying — their bounded-
+    queue backpressure engages only when their own sub-batch is already
+    banked) and one fast fleet from crowding a slow one out of the stream.
+
+    Two output shapes, same ``next_batch`` contract as :class:`_BatchFeed`:
+
+    - ``stacked=True`` (macro-batching, the default): a batch is ready when
+      EVERY fleet banked ``batch_size`` of its own items; per-fleet
+      sub-batches are collated separately and stacked on a new leading
+      fleet axis — ``{k: [K, ...]}`` — exactly the layout the macro steps
+      (parallel/train_step.py make_macro_train_step and friends) shard
+      fleet-major over the mesh. A dead fleet therefore stalls the stream
+      (the learner's feed timeout turns that into a loud failure, same as
+      a dead single-fleet plane).
+    - ``stacked=False``: items interleave round-robin into one flat
+      ``batch_size`` batch (the single-stream ``feed_batch`` contract) —
+      the merge shape for a learner that wants fleet-blind batches.
+
+    Ring-safety contract (utils/shm.py): each fleet's holder pins at most
+    ``batch_size`` of ITS OWN ring views between collates (stacked mode),
+    so every fleet master's ``feed_batch`` must be set to this feed's
+    ``batch_size`` — same declaration TrainFeed call sites make.
+    """
+
+    _POLL_S = 0.002
+
+    def __init__(
+        self,
+        queues: List["queue.Queue"],
+        batch_size: int,
+        collate: "Callable[[List], Dict[str, np.ndarray]]" = collate_train,
+        stacked: bool = True,
+        prefetch: int = 2,
+    ):
+        if not queues:
+            raise ValueError("FleetMergeFeed needs at least one fleet queue")
+        self.queues = list(queues)
+        self.batch_size = batch_size
+        self.stacked = stacked
+        self._collate_one = collate
+        self._out: "queue.Queue[Dict[str, np.ndarray]]" = queue.Queue(
+            maxsize=prefetch
         )
-        return batch
+        self._thread = StoppableThread(
+            target=self._loop, daemon=True, name=type(self).__name__
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._thread.stop()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    def next_batch(self, timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+        return self._out.get(timeout=timeout)
+
+    def qsize(self) -> int:
+        return self._out.qsize()
+
+    def _loop(self) -> None:
+        t = threading.current_thread()
+        assert isinstance(t, StoppableThread)
+        K, B = len(self.queues), self.batch_size
+        holders: List[list] = [[] for _ in range(K)]
+        flat: list = []
+        rr = 0  # flat mode: fleet owed the next slot (round-robin cursor)
+        while not t.stopped():
+            drew = False
+            order = [(rr + off) % K for off in range(K)]  # freeze this pass
+            for k in order:
+                if self.stacked and len(holders[k]) >= B:
+                    continue  # sub-batch banked: leave backpressure to act
+                try:
+                    item = self.queues[k].get_nowait()
+                except queue.Empty:
+                    continue
+                drew = True
+                if self.stacked:
+                    holders[k].append(item)
+                else:
+                    flat.append(item)
+                    rr = (k + 1) % K  # next pass starts past the last draw
+                    if len(flat) == B:
+                        if not t.queue_put_stoppable(
+                            self._out, self._collate_one(flat), timeout=0.2
+                        ):
+                            return
+                        flat = []
+            if self.stacked and all(len(h) == B for h in holders):
+                subs = [self._collate_one(h) for h in holders]
+                batch = {
+                    key: np.stack([s[key] for s in subs])
+                    for key in subs[0]
+                }
+                holders = [[] for _ in range(K)]
+                if not t.queue_put_stoppable(self._out, batch, timeout=0.2):
+                    return
+            if not drew:
+                # every queue empty (or banked full): bounded sleep-poll,
+                # the FastQueue idiom — never a condvar wait on K queues
+                time.sleep(self._POLL_S)

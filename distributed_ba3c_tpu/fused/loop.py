@@ -569,10 +569,13 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
             f"--steps_per_dispatch {k_dispatch} must divide "
             f"--steps_per_epoch {args.steps_per_epoch}"
         )
+    fleet_accum = max(1, getattr(args, "fleet_accum", 1) or 1)
     if getattr(args, "overlap", False):
         # two overlapped compiled programs (rollout k+1 concurrent with
         # learner k, lag-1 V-trace correction) instead of the single fused
-        # program — docs/overlap.md
+        # program — docs/overlap.md. --fleet_accum K adds the macro
+        # learner: K rollout windows ("fleets") accumulated into ONE
+        # update (docs/actor_plane.md multi-fleet macro-batching)
         from distributed_ba3c_tpu.fused.overlap import make_overlap_step
 
         step = make_overlap_step(
@@ -580,6 +583,7 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
             grad_chunk_samples=args.grad_chunk_samples,
             steps_per_dispatch=k_dispatch,
             rollout_dtype=getattr(args, "rollout_dtype", "float32"),
+            macro_fleets=fleet_accum,
         )
     else:
         step = make_fused_step(
@@ -630,11 +634,15 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
         # would mute the guard after its first catch)
         ckpt.write_run_meta(**run_shape)
     logger.set_logger_dir(args.logdir)
-    samples_per_iter = n_envs * rollout_len
+    # each update consumes fleet_accum rollout windows: the fps/samples
+    # account must bill every env-step or the rate under-reports K-fold
+    samples_per_iter = n_envs * rollout_len * fleet_accum
     logger.info(
-        "fused training: %d envs x %d rollout = %d samples/iter on %d devices",
+        "fused training: %d envs x %d rollout x %d accum windows = "
+        "%d samples/iter on %d devices",
         n_envs,
         rollout_len,
+        fleet_accum,
         samples_per_iter,
         n_data,
     )

@@ -80,7 +80,10 @@ from distributed_ba3c_tpu.parallel.mesh import (
     grad_allreduce,
     shard_map,
 )
-from distributed_ba3c_tpu.parallel.train_step import TrainState
+from distributed_ba3c_tpu.parallel.train_step import (
+    TrainState,
+    macro_accumulate,
+)
 
 import optax
 
@@ -130,6 +133,7 @@ def make_overlap_step(
     steps_per_dispatch: int = 1,
     lag: int = 1,
     rollout_dtype: str = "float32",
+    macro_fleets: int = 1,
 ) -> Callable:
     """Build the overlapped two-program step facade.
 
@@ -138,6 +142,16 @@ def make_overlap_step(
     interchangeably. ``steps_per_dispatch`` here is the number of
     actor/learner iteration PAIRS dispatched per facade call (all async;
     the epoch loop's metrics fetch is the only sync).
+
+    ``macro_fleets`` > 1 is the fused half of multi-fleet macro-batching
+    (docs/actor_plane.md): the actor program runs K rollout windows per
+    update — K "fleets" of trajectory blocks under one params snapshot —
+    and a MACRO learner (``fused.macro_learner``) accumulates their
+    gradients into ONE update. Per-update effective batch grows K-fold
+    while every fwd+bwd still runs at the single-window full-occupancy
+    shape (the macro-batching contract); behavior lag within the window
+    spans 1..K updates and V-trace's clipped importance weights correct
+    it exactly as they do the lag-1 schedule.
     """
     if lag not in (0, 1):
         raise ValueError(f"lag must be 0 or 1, got {lag}")
@@ -145,6 +159,8 @@ def make_overlap_step(
         raise ValueError(
             f"rollout_dtype must be one of {ROLLOUT_DTYPES}, got {rollout_dtype!r}"
         )
+    if macro_fleets < 1:
+        raise ValueError(f"macro_fleets must be >= 1, got {macro_fleets}")
 
     # ---------------- actor program (fused.actor) -------------------------
     def local_actor(params, astate: ActorState):
@@ -237,10 +253,13 @@ def make_overlap_step(
     prep_jit = tripwire_jit("fused.prep", prep_fn)
 
     # ---------------- learner program (fused.learner) ----------------------
-    def local_learner(train: TrainState, block: TrajBlock, entropy_beta,
-                      learning_rate):
+    def block_grads(params, block: TrajBlock, entropy_beta):
+        """Per-block V-trace grads + aux (env-column chunked) — the ONE
+        gradient body both the single learner and the multi-fleet macro
+        learner (``fused.macro_learner``) run, so the macro program's
+        chunked-vs-full equivalence contract extends the one the overlap
+        learner already established."""
         T, B = block.actions.shape
-        params = train.params
 
         # chunk over ENV COLUMNS, not the flat [T*B] batch: V-trace's
         # reverse scan couples a whole env column in time but columns are
@@ -357,24 +376,37 @@ def make_overlap_step(
             (grads, aux_sum), _ = jax.lax.scan(acc_body, (g0, aux0), rest)
             grads = jax.tree_util.tree_map(lambda g: g / n_chunks, grads)
             aux = jax.tree_util.tree_map(lambda a: a / n_chunks, aux_sum)
+        return grads, aux
 
+    def finish_update(train: TrainState, grads, aux, rewards, learning_rate):
+        """The learner tail — ONE definition for the single and macro
+        programs (psum + mean + LR injection + Adam + pmean'd metrics):
+        a tail fix applied to one copy must not silently diverge the
+        other (review finding)."""
         grads = grad_allreduce(grads, DATA_AXIS)
         n_data = axis_size(DATA_AXIS)
         grads = jax.tree_util.tree_map(lambda g: g / n_data, grads)
 
         opt_state = inject_learning_rate(train.opt_state, learning_rate)
-        updates, new_opt_state = optimizer.update(grads, opt_state, params)
-        new_params = optax.apply_updates(params, updates)
+        updates, new_opt_state = optimizer.update(
+            grads, opt_state, train.params
+        )
+        new_params = optax.apply_updates(train.params, updates)
         new_train = TrainState(
             step=train.step + 1, params=new_params, opt_state=new_opt_state
         )
         metrics = {
             **aux,
             **grad_summaries(grads),
-            "reward_per_step": jnp.mean(block.rewards),
+            "reward_per_step": jnp.mean(rewards),
         }
         metrics = {k: jax.lax.pmean(v, DATA_AXIS) for k, v in metrics.items()}
         return new_train, metrics
+
+    def local_learner(train: TrainState, block: TrajBlock, entropy_beta,
+                      learning_rate):
+        grads, aux = block_grads(train.params, block, entropy_beta)
+        return finish_update(train, grads, aux, block.rewards, learning_rate)
 
     learner_sharded = shard_map(
         local_learner,
@@ -389,6 +421,53 @@ def make_overlap_step(
     learner_jit = tripwire_jit(
         "fused.learner", learner_sharded, donate_argnums=(0,)
     )
+
+    # ---------------- macro learner (fused.macro_learner) ------------------
+    # K trajectory blocks -> ONE update: per-block grads (the SAME
+    # block_grads body the single learner runs, chunking included) are
+    # accumulated with a lax.scan over the stacked fleet axis, then a
+    # single psum + Adam. Mean-of-equal-window grads == the [T, K*B]
+    # full-batch gradient (V-trace couples time, never envs) — the
+    # chunked-vs-full equivalence gate extended over the fleet axis
+    # (tests/test_fleet.py pins it against the single learner on
+    # env-concatenated blocks).
+    macro_learner_jit = None
+    if macro_fleets > 1:
+        K = macro_fleets
+
+        def local_macro_learner(train: TrainState, blocks, entropy_beta,
+                                learning_rate):
+            # stack K blocks fleet-major INSIDE the program (XLA fuses the
+            # concat into the scan's gather; the facade ships the blocks
+            # as-is, no host-side copies), accumulate with the SAME scan
+            # idiom as the ZMQ macro steps, finish with the shared tail
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *blocks
+            )
+
+            def loss_grad_one(params, blk):
+                g, aux = block_grads(params, blk, entropy_beta)
+                return (None, aux), g  # macro_accumulate's ((_, aux), g)
+
+            grads, aux = macro_accumulate(
+                loss_grad_one, train.params, stacked, K
+            )
+            return finish_update(
+                train, grads, aux, stacked.rewards, learning_rate
+            )
+
+        macro_learner_sharded = shard_map(
+            local_macro_learner,
+            mesh=mesh,
+            in_specs=(P(), (block_specs,) * K, P(), P()),
+            out_specs=(P(), P()),
+        )
+        # registered audit entry point: donated train state, exactly-once
+        # grad psum for the WHOLE macro batch; the K blocks stay undonated
+        # for the same double-buffer reason as the single learner's block
+        macro_learner_jit = tripwire_jit(
+            "fused.macro_learner", macro_learner_sharded, donate_argnums=(0,)
+        )
 
     # ---------------- ep_stats: window-boundary episode metrics -----------
     def local_ep_stats(ep_cnt, ep_sum):
@@ -414,11 +493,24 @@ def make_overlap_step(
         beta_arr = jnp.asarray(entropy_beta, jnp.float32)
         lr_arr = jnp.asarray(learning_rate, jnp.float32)
         train, astate, block = state.train, state.actor, state.block
+
+        def roll(aparams, astate):
+            # macro mode: K rollout windows ("fleets") under ONE snapshot,
+            # all dispatches async — the env carry chains through, so the
+            # K blocks tile time with no gaps. Single-window mode returns
+            # the bare block (the single learner's input shape).
+            blocks = []
+            for _ in range(macro_fleets):
+                astate, b = actor_jit(aparams, astate)
+                blocks.append(b)
+            return astate, blocks[0] if macro_fleets == 1 else tuple(blocks)
+
+        learn = macro_learner_jit if macro_fleets > 1 else learner_jit
         if lag and block is None:
-            # prime the pipeline: one rollout before the first update so
-            # learner k always has block k-1 resident
+            # prime the pipeline: one rollout window (or K of them) before
+            # the first update so learner k always has its k-1 input resident
             aparams = prep_jit(train.params)
-            astate, block = actor_jit(aparams, astate)
+            astate, block = roll(aparams, astate)
         ms = []
         for _ in range(steps_per_dispatch):
             aparams = prep_jit(train.params)
@@ -426,12 +518,12 @@ def make_overlap_step(
                 # the two dispatches the whole module exists for: rollout
                 # k+1 (reading only the snapshot) enqueued back-to-back
                 # with learner k — no host sync in between (J6)
-                astate, next_block = actor_jit(aparams, astate)
-                train, m = learner_jit(train, block, beta_arr, lr_arr)
+                astate, next_block = roll(aparams, astate)
+                train, m = learn(train, block, beta_arr, lr_arr)
                 block = next_block
             else:
-                astate, block0 = actor_jit(aparams, astate)
-                train, m = learner_jit(train, block0, beta_arr, lr_arr)
+                astate, block0 = roll(aparams, astate)
+                train, m = learn(train, block0, beta_arr, lr_arr)
             ms.append(m)
         if len(ms) == 1:
             metrics = dict(ms[0])
@@ -497,6 +589,12 @@ def make_overlap_step(
         ``overlap_efficiency`` is the learner-hidden fraction of the actor
         program: (t_actor + t_learner - t_pair) / t_actor.
         """
+        if macro_fleets > 1:
+            raise NotImplementedError(
+                "probe_overlap measures the single-window actor/learner "
+                "pair — run it on a macro_fleets=1 step (the macro "
+                "learner's cost profile is pinned by its own audit entry)"
+            )
         if learning_rate is None:
             learning_rate = cfg.learning_rate
         beta_arr = jnp.asarray(entropy_beta, jnp.float32)
@@ -566,9 +664,12 @@ def make_overlap_step(
     step.steps_per_dispatch = steps_per_dispatch
     step.lag = lag
     step.rollout_dtype = rollout_dtype
+    step.macro_fleets = macro_fleets
     step.reset_episode_stats = reset_episode_stats
     step.probe_overlap = probe_overlap
-    # tools/ba3caudit traces THESE programs (two entries, one step)
+    # tools/ba3caudit traces THESE programs (two entries, one step;
+    # three with the macro learner)
     step.actor_jit = actor_jit
     step.learner_jit = learner_jit
+    step.macro_learner_jit = macro_learner_jit
     return step

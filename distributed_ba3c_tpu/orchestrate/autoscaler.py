@@ -42,16 +42,37 @@ def master_signals(master) -> Callable[[], Dict[str, float]]:
     return master.fleet_snapshot
 
 
-def http_signals(url: str, timeout_s: float = 2.0) -> Callable[[], Dict[str, float]]:
+def http_signals(
+    url: str, timeout_s: float = 2.0, fleet: Optional[int] = None
+) -> Callable[[], Dict[str, float]]:
     """Signal source over a ``--telemetry_port`` ``/json`` endpoint (for a
-    supervisor running outside the learner process)."""
+    supervisor running outside the learner process).
+
+    ``fleet`` addresses ONE master among several on the scrape target: a
+    multi-fleet learner (``--fleets N``) exports each master under its
+    per-fleet role (``master.f<k>``, telemetry.fleet_role — the per-fleet
+    scrape label), and an actor host supervising fleet k's env servers
+    must autoscale on THAT fleet's queue fill, not on whichever master
+    happened to register last (the pre-fleet exporter assumed one master
+    registry per process). ``None`` keeps the single-fleet ``master``
+    role. A missing role fails LOUDLY — all-zero signals would read as
+    permanent starvation and ratchet the fleet to fleet_max on a typo'd
+    fleet index.
+    """
     if not url.endswith("/json"):
         url = url.rstrip("/") + "/json"
+    role = telemetry.fleet_role("master", fleet)
 
     def scrape() -> Dict[str, float]:
         with urllib.request.urlopen(url, timeout=timeout_s) as r:
             doc = json.loads(r.read().decode())
-        master = doc.get("master", {})
+        master = doc.get(role)
+        if master is None:
+            raise KeyError(
+                f"scrape target {url} exports no {role!r} registry "
+                f"(roles: {sorted(doc)}) — wrong --fleet index, or the "
+                "learner is not running --fleets"
+            )
 
         def val(name: str) -> float:
             return float(master.get(name, {}).get("value", 0.0))

@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_ba3c_tpu.audit import tripwire_jit
 from distributed_ba3c_tpu.config import BA3CConfig
 from distributed_ba3c_tpu.models.a3c import BA3CNet
-from distributed_ba3c_tpu.ops.gradproc import grad_summaries, inject_learning_rate
+from distributed_ba3c_tpu.ops.gradproc import grad_summaries
 from distributed_ba3c_tpu.ops.vtrace import vtrace_returns
 from distributed_ba3c_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -38,18 +38,24 @@ from distributed_ba3c_tpu.parallel.mesh import (
     grad_allreduce,
     shard_map,
 )
-from distributed_ba3c_tpu.parallel.train_step import TrainState
+from distributed_ba3c_tpu.parallel.train_step import (
+    TrainState,
+    apply_grads,
+    macro_accumulate,
+)
 
 
-def _local_step(
+def _make_vtrace_loss_fn(
     model: BA3CNet,
-    optimizer: optax.GradientTransformation,
     cfg: BA3CConfig,
-    state: TrainState,
     batch: Dict[str, jax.Array],
     entropy_beta: jax.Array,
-    learning_rate: jax.Array,
-) -> Tuple[TrainState, Dict[str, jax.Array]]:
+):
+    """The per-(sub-)batch V-trace loss closure — ONE definition shared by
+    the single step and the multi-fleet macro step (the macro step must
+    optimize exactly the single step's objective, sub-batch by sub-batch;
+    V-trace couples TIME within an env column but never envs, so equal-size
+    sub-batch gradient means equal the full-batch gradient)."""
     T, B = batch["action"].shape
 
     def loss_fn(params):
@@ -95,17 +101,25 @@ def _local_step(
         }
         return total, aux
 
+    return loss_fn
+
+
+def _local_step(
+    model: BA3CNet,
+    optimizer: optax.GradientTransformation,
+    cfg: BA3CConfig,
+    state: TrainState,
+    batch: Dict[str, jax.Array],
+    entropy_beta: jax.Array,
+    learning_rate: jax.Array,
+) -> Tuple[TrainState, Dict[str, jax.Array]]:
+    loss_fn = _make_vtrace_loss_fn(model, cfg, batch, entropy_beta)
     (_, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
     grads = grad_allreduce(grads, DATA_AXIS)
     n_data = axis_size(DATA_AXIS)
     grads = jax.tree_util.tree_map(lambda g: g / n_data, grads)
 
-    opt_state = inject_learning_rate(state.opt_state, learning_rate)
-    updates, new_opt_state = optimizer.update(grads, opt_state, state.params)
-    new_params = optax.apply_updates(state.params, updates)
-    new_state = TrainState(
-        step=state.step + 1, params=new_params, opt_state=new_opt_state
-    )
+    new_state = apply_grads(optimizer, state, grads, learning_rate)
     metrics = {**aux, **grad_summaries(grads)}
     metrics = {k: jax.lax.pmean(v, DATA_AXIS) for k, v in metrics.items()}
     return new_state, metrics
@@ -152,5 +166,95 @@ def make_vtrace_train_step(
     }
     step.state_sharding = NamedSharding(mesh, replicated)
     step.mesh = mesh
+    step.audit_jit = jitted  # tools/ba3caudit traces THIS program
+    return step
+
+
+def make_vtrace_macro_step(
+    model: BA3CNet,
+    optimizer: optax.GradientTransformation,
+    cfg: BA3CConfig,
+    mesh: Mesh,
+    n_fleets: int,
+) -> Callable:
+    """The multi-fleet V-trace macro step: N fleet sub-batches, ONE update.
+
+    Batch layout: every make_vtrace_train_step leaf gains a leading FLEET
+    axis (``state [K, T, B, ...]``, ``bootstrap_state [K, B, ...]``, ...)
+    and the FLEET axis shards over the mesh's data axis — whole fleets to
+    chips, never ``B/D`` slivers, so each chip's fwd+bwd runs the full
+    per-fleet unroll batch (docs/actor_plane.md). Chips hosting several
+    fleets accumulate sequentially (parallel/train_step.py
+    macro_accumulate); ONE gradient psum means over every fleet. V-trace
+    couples time within an env column but never envs, so the accumulated
+    mean equals the ``[T, K*B]`` full-batch gradient to fp tolerance
+    (tests/test_fleet.py pins it).
+
+    Registered audit entry: ``parallel.vtrace_macro_step``.
+    """
+    if n_fleets < 1:
+        raise ValueError(f"n_fleets must be >= 1, got {n_fleets}")
+    n_data = mesh.shape[DATA_AXIS]
+    if n_fleets % n_data:
+        raise ValueError(
+            f"n_fleets {n_fleets} must be divisible by the mesh data axis "
+            f"{n_data}: fleets shard fleet-major over chips (whole "
+            "sub-batches, never slivers)"
+        )
+    n_local = n_fleets // n_data
+
+    def local_macro_step(state, batch, entropy_beta, learning_rate):
+        def loss_grad_one(params, sub):
+            loss_fn = _make_vtrace_loss_fn(model, cfg, sub, entropy_beta)
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+        grads, aux = macro_accumulate(
+            loss_grad_one, state.params, batch, n_local
+        )
+        # ONE collective for the whole macro batch (T3 census unchanged)
+        grads = grad_allreduce(grads, DATA_AXIS)
+        grads = jax.tree_util.tree_map(lambda g: g / n_data, grads)
+        new_state = apply_grads(optimizer, state, grads, learning_rate)
+        metrics = {**aux, **grad_summaries(grads)}
+        metrics = {k: jax.lax.pmean(v, DATA_AXIS) for k, v in metrics.items()}
+        return new_state, metrics
+
+    replicated = P()
+    fleet_spec = P(DATA_AXIS)  # leading = FLEET axis on every leaf
+    specs = {
+        "state": fleet_spec,
+        "action": fleet_spec,
+        "reward": fleet_spec,
+        "done": fleet_spec,
+        "behavior_log_probs": fleet_spec,
+        "bootstrap_state": fleet_spec,
+    }
+    sharded = shard_map(
+        local_macro_step,
+        mesh=mesh,
+        in_specs=(replicated, specs, replicated, replicated),
+        out_specs=(replicated, replicated),
+    )
+    # registered audit entry point (distributed_ba3c_tpu/audit.py)
+    jitted = tripwire_jit(
+        "parallel.vtrace_macro_step", sharded, donate_argnums=(0,)
+    )
+
+    def step(state, batch, entropy_beta, learning_rate=None):
+        if learning_rate is None:
+            learning_rate = cfg.learning_rate
+        return jitted(
+            state,
+            batch,
+            jnp.asarray(entropy_beta, jnp.float32),
+            jnp.asarray(learning_rate, jnp.float32),
+        )
+
+    step.batch_sharding = {
+        k: NamedSharding(mesh, s) for k, s in specs.items()
+    }
+    step.state_sharding = NamedSharding(mesh, replicated)
+    step.mesh = mesh
+    step.n_fleets = n_fleets
     step.audit_jit = jitted  # tools/ba3caudit traces THIS program
     return step

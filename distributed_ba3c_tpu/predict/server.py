@@ -213,6 +213,9 @@ class BatchedPredictor:
         continuous-batching default: fetch k only after dispatching k+1).
     clock: monotonic-clock callable (tests inject a fake clock to make
         shed decisions deterministic).
+    tele_role: telemetry registry role — ``predictor`` single-fleet,
+        ``telemetry.fleet_role("predictor", k)`` when a learner hosts one
+        predictor per fleet (docs/observability.md).
     """
 
     def __init__(
@@ -228,6 +231,7 @@ class BatchedPredictor:
         queue_depth: int = 4096,
         dispatch_depth: int = 2,
         clock: Optional[Callable[[], float]] = None,
+        tele_role: str = "predictor",
     ):
         import time as _time
 
@@ -272,7 +276,12 @@ class BatchedPredictor:
         # the predictor role registry; the bucket-occupancy histogram is
         # what separates "tiny fragmented batches" from "full buckets"
         # when the plane slows down. Unit=1: occupancies are row counts.
-        tele = telemetry.registry("predictor")
+        # per-fleet serving identity (telemetry.fleet_role): a learner
+        # hosting K fleets runs K predictors, and their occupancy/SLO
+        # series must not collapse into one registry (the fn-backed gauges
+        # would be silently rebound to whichever predictor came last)
+        tele = telemetry.registry(tele_role)
+        self.tele_role = tele_role
         self._tele = tele
         self._c_batches = tele.counter("batches_total")
         self._c_rows = tele.counter("rows_total")

@@ -35,6 +35,7 @@ from distributed_ba3c_tpu.telemetry.metrics import (  # noqa: F401
     all_registries,
     all_snapshots,
     enabled,
+    fleet_role,
     registry,
     reset_all,
     set_enabled,

@@ -77,15 +77,21 @@ def export_scalars(
     prefix: str = "tele/",
 ) -> Dict[str, float]:
     """Counters + gauges flattened to ``{"tele/<role>/<name>": value}`` for
-    the stat.json/TB writers (histograms export their _count/_sum)."""
+    the stat.json/TB writers (histograms export their _count/_sum).
+
+    Each requested role matches itself AND its per-fleet variants
+    (``master`` also exports ``master.f0``/``master.f1``/... — the
+    telemetry.fleet_role scheme), so a multi-fleet run's stat.json grows
+    the per-fleet series without every caller enumerating fleets.
+    """
     out: Dict[str, float] = {}
     regs = metrics.all_registries()
-    for role in roles:
-        reg = regs.get(role)
-        if reg is None:
-            continue
-        for name, v in reg.scalars().items():
-            out[f"{prefix}{role}/{name}"] = v
+    for base in roles:
+        for role in sorted(regs):
+            if role != base and not role.startswith(f"{base}.f"):
+                continue
+            for name, v in regs[role].scalars().items():
+                out[f"{prefix}{role}/{name}"] = v
     return out
 
 

@@ -44,6 +44,19 @@ def enabled() -> bool:
     return _enabled
 
 
+def fleet_role(base: str, fleet: Optional[int] = None) -> str:
+    """The canonical telemetry role for one fleet's plane component.
+
+    THE single formula (docs/observability.md): ``master``/``predictor``/
+    ``fleet`` for a single-fleet run (every existing dashboard keeps
+    working), ``master.f<k>`` etc. when a learner hosts several fleets —
+    the per-fleet scrape label ``http_signals``/``/json`` consumers key on.
+    Deriving it in two places would let the exporter and the autoscaler
+    address different registries.
+    """
+    return base if fleet is None else f"{base}.f{int(fleet)}"
+
+
 def set_enabled(flag: bool) -> None:
     """Flip the process-wide write switch (child processes inherit the
     ``BA3C_TELEMETRY`` env var instead — set both when spawning)."""

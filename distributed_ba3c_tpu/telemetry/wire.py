@@ -56,9 +56,17 @@ class DeltaTracker:
         return out
 
 
-#: master side: last-seen monotonic per sender ident, so the fleet client
-#: count reflects senders that piggybacked recently (not all time)
-_FLEET_SEEN: Dict[bytes, float] = {}
+#: master side: last-seen monotonic per (fleet role, sender ident), so the
+#: fleet client count reflects senders that piggybacked recently (not all
+#: time) — and a multi-fleet learner's per-fleet gauges count only their
+#: own senders. Keyed by (role, ident), NOT ident alone: two fleets'
+#: senders may legitimately share an ident (external fleets launched with
+#: launch_env_fleet's default cppsim-* prefixes collide across hosts —
+#: the master knows the fleet by which pipe the message arrived on), and
+#: an ident-keyed table would flap the stored role between fleets,
+#: corroding BOTH reporting_clients gauges toward 0 with every server
+#: healthy. ONE table across fleets: the 4096 cap is a process budget.
+_FLEET_SEEN: Dict[tuple, float] = {}
 _FLEET_WINDOW_S = 120.0
 
 #: hard cap on distinct fleet series (the shipped instrumentation uses a
@@ -74,23 +82,29 @@ _NAME_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*")
 _FLEET_MAX_SENDERS = 4096
 
 
-def _fleet_clients() -> int:
+def _fleet_clients(role: str = "fleet") -> int:
     now = time.monotonic()
     # read-time pruning of long-dead senders bounds the table under ident
     # churn (a restarting fleet cycles idents); entries get a long grace
     # past the liveness window so a stalled-then-recovered sender is not
     # forgotten between scrapes
     dead = [
-        i for i, t in list(_FLEET_SEEN.items())
+        k for k, t in list(_FLEET_SEEN.items())
         if now - t > 10 * _FLEET_WINDOW_S
     ]
-    for i in dead:
-        _FLEET_SEEN.pop(i, None)
-    return sum(1 for t in list(_FLEET_SEEN.values()) if now - t < _FLEET_WINDOW_S)
+    for k in dead:
+        _FLEET_SEEN.pop(k, None)
+    return sum(
+        1
+        for (r, _), t in list(_FLEET_SEEN.items())
+        if r == role and now - t < _FLEET_WINDOW_S
+    )
 
 
-def apply_fleet_deltas(ident: bytes, deltas) -> None:
-    """Fold one sender's piggybacked deltas into the ``fleet`` registry.
+def apply_fleet_deltas(ident: bytes, deltas, role: str = "fleet") -> None:
+    """Fold one sender's piggybacked deltas into the ``role`` registry
+    (``fleet`` for a single-fleet master, ``fleet.f<k>`` per fleet when a
+    learner hosts several — telemetry.fleet_role is the name formula).
 
     Wire input is untrusted (same posture as the block decoder): anything
     that is not a {str: number} mapping is dropped without touching the
@@ -98,9 +112,11 @@ def apply_fleet_deltas(ident: bytes, deltas) -> None:
     """
     if not isinstance(deltas, dict):
         return
-    reg = metrics.registry("fleet")
-    reg.gauge("reporting_clients", fn=_fleet_clients)
-    key = bytes(ident)
+    reg = metrics.registry(role)
+    reg.gauge(
+        "reporting_clients", fn=lambda r=role: _fleet_clients(r)
+    )
+    key = (role, bytes(ident))
     if key in _FLEET_SEEN or len(_FLEET_SEEN) < _FLEET_MAX_SENDERS:
         # bounded like the series table: a stray sender minting fresh
         # idents must not grow the table (and the gauge's O(n) read)
@@ -120,8 +136,14 @@ def apply_fleet_deltas(ident: bytes, deltas) -> None:
             # registry would poison every subsequent /metrics scrape
             continue
         if name not in reg._metrics and len(reg._metrics) >= _FLEET_MAX_SERIES:
-            # cardinality cap: a stray sender on the bound port must not
-            # be able to grow the process-global registry (and the
-            # /metrics payload) without bound by minting fresh names
+            # cardinality cap, PER fleet registry: a stray sender on a
+            # bound port must not be able to grow its fleet's registry
+            # (and the /metrics payload) without bound by minting fresh
+            # names. The cap stays per-registry rather than global because
+            # fleet ROLES are trusted — only a master's configured
+            # tele_role mints one — so the process total is bounded by
+            # K x 256 with K operator-chosen, while a global budget would
+            # let one fleet's junk senders crowd a later fleet's
+            # legitimate series out entirely
             continue
         reg.counter(name).inc(d)

@@ -85,6 +85,14 @@ def main(argv=None) -> int:
         help="the learner's --telemetry_port endpoint (http://host:port) "
         "— enables cross-host autoscaling between the fleet bounds",
     )
+    p.add_argument(
+        "--fleet_index", type=int, default=None,
+        help="which of a multi-fleet learner's masters to autoscale "
+        "against (--fleets N exports one registry per fleet as "
+        "master.f<k> — the per-fleet scrape label); default: the "
+        "single-fleet 'master' registry. This host's servers must also "
+        "connect to THAT fleet's derived pipe pair (docs/OPERATIONS.md)",
+    )
     p.add_argument("--autoscale_interval", type=float, default=2.0)
     p.add_argument(
         "--restart_budget", type=int, default=16,
@@ -158,7 +166,7 @@ def main(argv=None) -> int:
             return 2
         scaler = Autoscaler(
             supervisor,
-            http_signals(args.telemetry_url),
+            http_signals(args.telemetry_url, fleet=args.fleet_index),
             interval_s=args.autoscale_interval,
         )
 

@@ -81,6 +81,18 @@ def main() -> int:
         "shape is recorded in the JSON row either way",
     )
     ap.add_argument(
+        "--fleets", type=int, default=1,
+        help="ALSO measure N independent fleets at the SAME per-fleet "
+        "shape (per-fleet pipes/masters/predictors/telemetry roles, "
+        "fleet-tagged idents) and gate the aggregate device-free rate at "
+        ">= --fleet_gate x the single-fleet rate — the multi-fleet "
+        "macro-batching scaling proof (docs/actor_plane.md)",
+    )
+    ap.add_argument(
+        "--fleet_gate", type=float, default=1.6,
+        help="minimum aggregate/single-fleet ratio for the --fleets gate",
+    )
+    ap.add_argument(
         "--serving", action="store_true",
         help="ALSO run the SLO-serving latency-vs-throughput frontier "
         "(scripts/serving_bench.py default sweep) and embed it under "
@@ -140,6 +152,7 @@ def main() -> int:
 
     runs = {}
     overhead = {}
+    fleet_scaling = {}
     gate_failures = []
     for wire in wires:
         if wire == "per-env":
@@ -217,6 +230,42 @@ def main() -> int:
             stderr_print(
                 f"device-free {wire:8s}: {r['value']:>10.1f} env-steps/s/host"
             )
+        if args.fleets > 1:
+            # the multi-fleet arm at the SAME per-fleet shape, same
+            # session (this container's run-to-run scheduler drift makes
+            # cross-session ratios dishonest — PERF.md round 7); the
+            # single-fleet arm is the nodevice_{wire} row just measured
+            rf = bench_zmq_plane(
+                game=args.game, n_envs=n_envs, seconds=args.seconds,
+                null_device=True, wire=wire, envs_per_proc=per,
+                windows=args.windows,
+                telemetry_on=args.telemetry != "off",
+                fleets=args.fleets,
+            )
+            runs[f"nodevice_{wire}_fleets{args.fleets}"] = rf
+            single = runs[f"nodevice_{wire}"]["value"]
+            ratio = rf["value"] / max(single, 1e-9)
+            fleet_scaling[wire] = {
+                "fleets": args.fleets,
+                "single_fleet": single,
+                "aggregate": rf["value"],
+                "aggregate_over_single": round(ratio, 4),
+                "gate": args.fleet_gate,
+            }
+            stderr_print(
+                f"device-free {wire:8s} x{args.fleets} fleets: "
+                f"{rf['value']:>10.1f} aggregate = {ratio:.2f}x single"
+            )
+            if ratio < args.fleet_gate:
+                # verdict deferred to AFTER the JSON prints (evidence
+                # first), per the plane_bench convention
+                gate_failures.append(
+                    f"fleet scaling gate FAILED on {wire}: "
+                    f"{args.fleets}-fleet aggregate {rf['value']:.1f} is "
+                    f"{ratio:.2f}x the single-fleet {single:.1f} "
+                    f"(gate: >= {args.fleet_gate}x at equal per-fleet "
+                    "shape)"
+                )
         if args.device:
             r = bench_zmq_plane(
                 game=args.game, n_envs=n_envs, seconds=args.seconds,
@@ -250,6 +299,10 @@ def main() -> int:
         # ratio per wire, all measured alternating in THIS session
         # (PERF.md round 7 cites it)
         out["telemetry_overhead_on_over_off"] = overhead
+    if fleet_scaling:
+        # the multi-fleet scaling gate's evidence: single vs aggregate at
+        # equal per-fleet shape, same session (ISSUE-10 acceptance)
+        out["fleet_scaling"] = fleet_scaling
     if args.serving:
         # the SLO-serving frontier rides along (scripts/serving_bench.py
         # owns the sweep + gate; its default shape is device-free)

@@ -4,6 +4,7 @@ pattern here bypasses the supervisor's respawn/backoff/scale accounting."""
 import os
 import subprocess
 
+from distributed_ba3c_tpu.actors.fleet import build_fleet_planes
 from distributed_ba3c_tpu.actors.simulator import SimulatorProcess
 from distributed_ba3c_tpu.envs import native
 
@@ -29,3 +30,9 @@ def launch_remote_fleet(host):
 def fork_worker():
     # the repo is spawn-context-only
     return os.fork()
+
+
+def assemble_fleets(c2s, s2c, make_predictor, make_master, make_sup):
+    # multi-fleet assembly outside orchestrate/: K fleets of unaccounted
+    # spawns behind one call
+    return build_fleet_planes(4, c2s, s2c, make_predictor, make_master, make_sup)

@@ -266,8 +266,11 @@ def test_registry_names():
         "fused.actor",
         "fused.greedy_eval",
         "fused.learner",
+        "fused.macro_learner",
         "fused.step",
+        "parallel.train_macro_step",
         "parallel.train_step",
+        "parallel.vtrace_macro_step",
         "parallel.vtrace_step",
         "predict.server",
         "predict.server_greedy",

@@ -526,6 +526,13 @@ class AdhocMetricRule(Rule):
 _FLEET_PROC_SUFFIXES = (".CppEnvServerProcess", ".SimulatorProcess")
 _FLEET_PROC_BARE = {"CppEnvServerProcess", "SimulatorProcess"}
 
+#: the multi-fleet assembly entry point (actors/fleet.py): ONE call stands
+#: up K masters/predictors and hands K factories to K FleetSupervisors —
+#: K fleets' worth of spawns behind one name, so a stray call outside
+#: orchestrate/ bypasses K fleets' worth of lifecycle accounting
+_FLEET_ASSEMBLY_SUFFIXES = (".build_fleet_planes",)
+_FLEET_ASSEMBLY_BARE = {"build_fleet_planes"}
+
 #: fleet-role entry points a subprocess spawn may name
 _FLEET_ENTRY_FRAGMENTS = ("train.py", "launch_env_fleet")
 
@@ -547,10 +554,14 @@ class UnsupervisedFleetSpawnRule(Rule):
     ``SimulatorProcess`` constructed-and-started directly — or a
     ``subprocess.Popen`` of ``train.py``/``launch_env_fleet`` — bypasses
     all of it: the process that dies stays dead and nothing is accounted.
+    The multi-fleet assembly ``build_fleet_planes`` (actors/fleet.py) is
+    flagged the same way: one call stands up K fleets of spawns, so a
+    stray call multiplies the bypass K-fold.
     Route fleet roles through ``FleetSupervisor``/``LearnerSupervisor``,
     or suppress with the justification for why this spawn's lifecycle is
     otherwise owned (a factory HANDED to the supervisor parameterizes the
-    slot rather than spawning it — that is the sanctioned suppression).
+    slot rather than spawning it — that is the sanctioned suppression,
+    and the one cli.py's build_fleet_planes call site carries).
     ``os.fork`` and friends are flagged unconditionally: the repo is
     spawn-context-only (a fork from the threaded trainer can deadlock the
     child — envs/simulator.py).
@@ -579,6 +590,18 @@ class UnsupervisedFleetSpawnRule(Rule):
                     "fleet-role processes belong to a FleetSupervisor "
                     "(respawn/backoff/scale accounting; "
                     "docs/orchestration.md)",
+                )
+            elif (
+                resolved in _FLEET_ASSEMBLY_BARE
+                or resolved.endswith(_FLEET_ASSEMBLY_SUFFIXES)
+            ):
+                yield ctx.finding(
+                    self, node,
+                    "multi-fleet assembly (build_fleet_planes) outside "
+                    "orchestrate/ — K fleets of spawns need their "
+                    "factories supervisor-owned; the sanctioned call "
+                    "sites (cli.py's factory-only assembly) carry an "
+                    "explicit suppression (docs/actor_plane.md)",
                 )
             elif resolved in _RAW_FORKS:
                 yield ctx.finding(
