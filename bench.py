@@ -362,15 +362,16 @@ def make_null_predictor(model, params, n_actions: int, service_s: float = 0.0,
 
 
 def _role_scalars(base: str) -> dict:
-    """Summed counters/gauges over ``base`` AND its per-fleet variants
-    (``master`` + ``master.f0``/``master.f1``/... — telemetry.fleet_role):
+    """Summed counters/gauges over ``base`` AND its dotted sub-roles
+    (``master`` + ``master.f0``/``master.f1``/... — telemetry.fleet_role;
+    ``pod`` + ``pod.host0``/``pod.host1``/... — pod/wire.py pod_role):
     the bench's progress/attribution reads must see the WHOLE plane, not
-    one fleet of it."""
+    one fleet (or one actor host) of it."""
     from distributed_ba3c_tpu import telemetry
 
     out: dict = {}
     for role, reg in telemetry.all_registries().items():
-        if role != base and not role.startswith(f"{base}.f"):
+        if role != base and not role.startswith(f"{base}."):
             continue
         for name, v in reg.scalars().items():
             out[name] = out.get(name, 0.0) + v

@@ -84,8 +84,11 @@ class FanoutPredictors:
         return self.predictors[0].num_actions
 
     def update_params(self, params, policy: str = "default") -> None:
-        for p in self.predictors:
-            p.update_params(params, policy=policy)
+        # fan-out facade, not a new publish path: the ONE sanctioned
+        # caller (Trainer._publish_params) owns the version accounting;
+        # this loop only multiplies its publish across fleets
+        for pred in self.predictors:
+            pred.update_params(params, policy=policy)  # ba3clint: disable=A10
 
     def predict_batch(self, states):
         return self.predictors[0].predict_batch(states)

@@ -31,12 +31,16 @@ from distributed_ba3c_tpu.utils.concurrency import FastQueue
 
 
 class _Step:
-    __slots__ = ("state", "action", "logp", "reward", "done")
+    __slots__ = ("state", "action", "logp", "value", "reward", "done")
 
-    def __init__(self, state, action, logp):
+    def __init__(self, state, action, logp, value=0.0):
         self.state = state
         self.action = action
         self.logp = logp
+        # V_mu(s_t) as served — emitted only by record_values masters
+        # (the pod's value_lag_mae input); stored always, it is already
+        # in the predictor callback's hand
+        self.value = value
         self.reward = 0.0
         self.done = False
 
@@ -46,7 +50,16 @@ class VTraceSimulatorMaster(SimulatorMaster):
 
     ``{"state": [T,...], "action": [T], "reward": [T], "done": [T],
        "behavior_log_probs": [T], "bootstrap_state": [...]}``
+
+    ``record_values`` (class attribute, default False) adds a
+    ``"behavior_values": [T]`` key to every segment — the pod master
+    (pod/host.py) flips it for the ``value_lag_mae`` staleness input.
+    The V-trace plane keeps it off: its learner feed has no spec for the
+    key, and ONE emission path serving both planes is the point (the
+    make_finish_update lesson — a flush fix must not diverge by copy).
     """
+
+    record_values = False
 
     def __init__(
         self,
@@ -91,7 +104,7 @@ class VTraceSimulatorMaster(SimulatorMaster):
             # this very action, so the master cannot reslice client.memory
             # until send_action below releases it (protocol serialization;
             # the BA3C_SANITIZE=1 job watches the table half of this claim)
-            client.memory.append(_Step(state, action, logp))  # ba3clint: disable=A3
+            client.memory.append(_Step(state, action, logp, value))  # ba3clint: disable=A3
             self.send_action(ident, action)
 
         # shed fallback (docs/serving.md): the uniform logp the fallback
@@ -151,6 +164,10 @@ class VTraceSimulatorMaster(SimulatorMaster):
             "behavior_log_probs": np.asarray([s.logp for s in seg], np.float32),
             "bootstrap_state": rest[0].state,
         }
+        if self.record_values:
+            segment["behavior_values"] = np.asarray(
+                [s.value for s in seg], np.float32
+            )
         client.memory = rest
         # backpressure pauses actors, but must stay shutdown-responsive
         self._put_stoppable(self.queue, segment)
@@ -207,6 +224,12 @@ class VTraceSimulatorMaster(SimulatorMaster):
                     # the (T+1)-th step's state: bootstrap AND next head
                     "bootstrap_state": blk.steps[s + T].states[j],
                 }
+                if self.record_values:
+                    # BlockStep already carries the served values — the
+                    # V-trace plane just never emits them
+                    segment["behavior_values"] = np.asarray(
+                        [st.values[j] for st in seg], np.float32
+                    )
                 blk.start[j] = s + T
                 self._put_stoppable(self.queue, segment)
                 # batched telemetry per emitted segment (T datapoints, one

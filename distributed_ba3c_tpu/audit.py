@@ -32,6 +32,9 @@ The registered entry points (one per hot-path jit site):
     predict.server        the batched action-server forward (predict/server.py)
     predict.server_greedy the greedy (eval/play) server variant — [3, B]
                           packed fetch (the duplicated argmax row dropped)
+    pod.learner           the pod's bounded-staleness V-trace learner
+                          (pod/learner.py) — the fused.learner gradient
+                          body compiled standalone for host-fed blocks
 
 Canonical shapes are deliberately SMALL (the invariants are shape-class
 properties, not magnitude properties) and the canonical mesh is always the
@@ -643,6 +646,40 @@ def _build_predict_server() -> TraceTarget:
         # single-device serving path: any collective here means a mesh
         # sharding leaked into the action server
         allow_collectives=False,
+    )
+
+
+@register_entry("pod.learner")
+def _build_pod_learner() -> TraceTarget:
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ba3c_tpu.fused.overlap import TrajBlock
+    from distributed_ba3c_tpu.pod.learner import make_pod_learner_step
+
+    cfg, model, opt = _canonical_parts()
+    mesh = canonical_mesh()
+    step = make_pod_learner_step(model, opt, cfg, mesh)
+    train = _state_avals(model, cfg, opt)
+    T, B = 4, 2 * CANONICAL_MESH_DEVICES  # one canonical host-fed block
+    sds = jax.ShapeDtypeStruct
+    block = TrajBlock(
+        states=sds((T, B, *cfg.state_shape), jnp.uint8),
+        actions=sds((T, B), jnp.int32),
+        rewards=sds((T, B), jnp.float32),
+        dones=sds((T, B), jnp.float32),
+        behavior_log_probs=sds((T, B), jnp.float32),
+        behavior_values=sds((T, B), jnp.float32),
+        bootstrap_state=sds((B, *cfg.state_shape), jnp.uint8),
+    )
+    return TraceTarget(
+        name="pod.learner",
+        jit_fn=step.audit_jit,
+        args=(train, block, _scalar(jnp.float32), _scalar(jnp.float32)),
+        grad_shapes=_grad_shapes(train.params),
+        # same donation contract as fused.learner: only the train state —
+        # the block stays live for the LaggedBlockDriver's double buffer
+        donated_nonscalar_indices=_donated_indices(train),
     )
 
 

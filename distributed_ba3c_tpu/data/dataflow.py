@@ -147,9 +147,16 @@ def collate_train(holder: List[list]) -> Dict[str, np.ndarray]:
 
 def collate_rollout(holder: List[dict]) -> Dict[str, np.ndarray]:
     """V-trace segment dicts → time-major [T, B] arrays (shared by
-    :class:`RolloutFeed` and the multi-fleet merge, like collate_train)."""
+    :class:`RolloutFeed`, the multi-fleet merge AND the pod experience
+    shipper, like collate_train). ``behavior_values`` rides along when the
+    emitting master records it (pod/host.py PodSimulatorMaster — the
+    ``value_lag_mae`` input); the V-trace planes' segments simply lack the
+    key and their batch layout is unchanged."""
     batch = {}
-    for k in ("state", "action", "reward", "done", "behavior_log_probs"):
+    keys = ("state", "action", "reward", "done", "behavior_log_probs")
+    if "behavior_values" in holder[0]:
+        keys += ("behavior_values",)
+    for k in keys:
         stacked = np.stack([seg[k] for seg in holder], axis=0)  # [B,T,...]
         batch[k] = np.swapaxes(stacked, 0, 1).copy()  # [T,B,...]
     batch["bootstrap_state"] = np.stack(

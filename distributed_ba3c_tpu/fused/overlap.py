@@ -90,6 +90,170 @@ import optax
 ROLLOUT_DTYPES = ("float32", "bfloat16")
 
 
+def make_block_grads(
+    model: BA3CNet, cfg: BA3CConfig, grad_chunk_samples: int = 4096
+) -> Callable:
+    """Per-block V-trace grads + aux (env-column chunked) — the ONE
+    gradient body the overlap learner, the multi-fleet macro learner AND
+    the pod's bounded-staleness learner (pod/learner.py) all run. The
+    correction reads the block's recorded behavior log-probs, so it is
+    exact at ANY measured params lag — lag never enters the program, only
+    the data; that is what lets the pod generalize the overlap split's
+    fixed lag-1 without a new gradient path to re-verify."""
+
+    def block_grads(params, block: TrajBlock, entropy_beta):
+        T, B = block.actions.shape
+
+        # chunk over ENV COLUMNS, not the flat [T*B] batch: V-trace's
+        # reverse scan couples a whole env column in time but columns are
+        # independent, so mean-of-column-chunk grads equals the full-batch
+        # gradient (same HBM-activation-cap role as the fused learner's
+        # flat chunks). At the flagship 128x20 shape T*B=2560 <=
+        # grad_chunk_samples, so the expected path is one chunk.
+        # clamp to B FIRST: an env column (T samples) is the smallest
+        # chunk this layout can make, and a start value above B would
+        # never find a divisor (the rounding loop below walks upward)
+        n_chunks = min(max(1, -(-(T * B) // grad_chunk_samples)), B)
+        while B % n_chunks:
+            n_chunks += 1
+        Bc = B // n_chunks
+
+        def chunk_loss(pp, chunk):
+            states_c, actions_c, rewards_c, dones_c, mu_lp_c, mu_v_c, boot_c = chunk
+            # one big forward over T*Bc + Bc states (conv batch stays
+            # MXU-sized; the bootstrap is valued under the TARGET policy)
+            flat = states_c.reshape((T * Bc, *states_c.shape[2:]))
+            all_states = jnp.concatenate([flat, boot_c], axis=0)
+            out = model.apply({"params": pp}, all_states)
+            logits = out.logits[: T * Bc].reshape((T, Bc, -1))
+            values = out.value[: T * Bc].reshape((T, Bc))
+            bootstrap_value = out.value[T * Bc:]
+
+            log_probs = jax.nn.log_softmax(logits, axis=-1)
+            probs = jax.nn.softmax(logits, axis=-1)
+            target_lp = jnp.take_along_axis(
+                log_probs, actions_c[..., None].astype(jnp.int32), axis=-1
+            )[..., 0]
+
+            vt = vtrace_returns(
+                behaviour_log_probs=mu_lp_c,
+                target_log_probs=jax.lax.stop_gradient(target_lp),
+                rewards=rewards_c,
+                dones=dones_c,
+                values=jax.lax.stop_gradient(values),
+                bootstrap_value=jax.lax.stop_gradient(bootstrap_value),
+                gamma=cfg.gamma,
+            )
+
+            # loss forms mirror ops/loss.py's a3c_loss (incl. the optional
+            # Huber value loss) so a lag-0 run optimizes the same objective
+            # as the fused step — at zero lag rho == c == 1 and the V-trace
+            # targets reduce exactly to the n-step returns.
+            policy_loss = -jnp.mean(target_lp * vt.pg_advantages)
+            if cfg.value_huber_delta is not None:
+                from distributed_ba3c_tpu.ops.symbolic import huber_loss
+
+                value_loss = jnp.mean(
+                    huber_loss(values - vt.vs, cfg.value_huber_delta)
+                )
+            else:
+                value_loss = 0.5 * jnp.mean(jnp.square(values - vt.vs))
+            entropy = -jnp.mean(jnp.sum(probs * log_probs, axis=-1))
+            total = (
+                policy_loss
+                + cfg.value_loss_coef * value_loss
+                - entropy_beta * entropy
+            )
+            aux = {
+                "loss": total,
+                "policy_loss": policy_loss,
+                "value_loss": value_loss,
+                "entropy": entropy,
+                "mean_rho": jnp.mean(vt.clipped_rhos),
+                "pred_value": jnp.mean(values),
+                # how far the value function moved across the policy lag —
+                # the observable the lag correction story rests on (and
+                # it keeps every block input live in the compiled program)
+                "value_lag_mae": jnp.mean(
+                    jnp.abs(jax.lax.stop_gradient(values) - mu_v_c)
+                ),
+            }
+            return total, aux
+
+        def chunk_grad(pp, chunk):
+            return jax.value_and_grad(chunk_loss, has_aux=True)(pp, chunk)
+
+        def col_chunk(x):
+            # [T, B, ...] -> [n_chunks, T, Bc, ...] (chunk c = env columns
+            # c*Bc:(c+1)*Bc — matches boot.reshape(n_chunks, Bc) below)
+            return x.reshape(T, n_chunks, Bc, *x.shape[2:]).swapaxes(0, 1)
+
+        full_chunk = (
+            block.states, block.actions, block.rewards, block.dones,
+            block.behavior_log_probs, block.behavior_values,
+            block.bootstrap_state,
+        )
+        if n_chunks == 1:
+            (_, aux), grads = chunk_grad(params, full_chunk)
+        else:
+            boot_c = block.bootstrap_state.reshape(
+                n_chunks, Bc, *block.bootstrap_state.shape[1:]
+            )
+            chunks = (
+                col_chunk(block.states), col_chunk(block.actions),
+                col_chunk(block.rewards), col_chunk(block.dones),
+                col_chunk(block.behavior_log_probs),
+                col_chunk(block.behavior_values), boot_c,
+            )
+
+            def acc_body(carry, chunk):
+                g_acc, aux_acc = carry
+                (_, aux), g = chunk_grad(params, chunk)
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                aux_acc = jax.tree_util.tree_map(jnp.add, aux_acc, aux)
+                return (g_acc, aux_acc), None
+
+            first = jax.tree_util.tree_map(lambda x: x[0], chunks)
+            (_, aux0), g0 = chunk_grad(params, first)
+            rest = jax.tree_util.tree_map(lambda x: x[1:], chunks)
+            (grads, aux_sum), _ = jax.lax.scan(acc_body, (g0, aux0), rest)
+            grads = jax.tree_util.tree_map(lambda g: g / n_chunks, grads)
+            aux = jax.tree_util.tree_map(lambda a: a / n_chunks, aux_sum)
+        return grads, aux
+
+    return block_grads
+
+
+def make_finish_update(optimizer: optax.GradientTransformation) -> Callable:
+    """The learner tail — ONE definition for the single, macro and pod
+    programs (psum + mean + LR injection + Adam + pmean'd metrics): a tail
+    fix applied to one copy must not silently diverge the others (review
+    finding, extended to pod/learner.py)."""
+
+    def finish_update(train: TrainState, grads, aux, rewards, learning_rate):
+        grads = grad_allreduce(grads, DATA_AXIS)
+        n_data = axis_size(DATA_AXIS)
+        grads = jax.tree_util.tree_map(lambda g: g / n_data, grads)
+
+        opt_state = inject_learning_rate(train.opt_state, learning_rate)
+        updates, new_opt_state = optimizer.update(
+            grads, opt_state, train.params
+        )
+        new_params = optax.apply_updates(train.params, updates)
+        new_train = TrainState(
+            step=train.step + 1, params=new_params, opt_state=new_opt_state
+        )
+        metrics = {
+            **aux,
+            **grad_summaries(grads),
+            "reward_per_step": jnp.mean(rewards),
+        }
+        metrics = {k: jax.lax.pmean(v, DATA_AXIS) for k, v in metrics.items()}
+        return new_train, metrics
+
+    return finish_update
+
+
 class ActorState(struct.PyTreeNode):
     """The env-side carry of the actor program (FusedState minus train)."""
 
@@ -253,155 +417,12 @@ def make_overlap_step(
     prep_jit = tripwire_jit("fused.prep", prep_fn)
 
     # ---------------- learner program (fused.learner) ----------------------
-    def block_grads(params, block: TrajBlock, entropy_beta):
-        """Per-block V-trace grads + aux (env-column chunked) — the ONE
-        gradient body both the single learner and the multi-fleet macro
-        learner (``fused.macro_learner``) run, so the macro program's
-        chunked-vs-full equivalence contract extends the one the overlap
-        learner already established."""
-        T, B = block.actions.shape
-
-        # chunk over ENV COLUMNS, not the flat [T*B] batch: V-trace's
-        # reverse scan couples a whole env column in time but columns are
-        # independent, so mean-of-column-chunk grads equals the full-batch
-        # gradient (same HBM-activation-cap role as the fused learner's
-        # flat chunks). At the flagship 128x20 shape T*B=2560 <=
-        # grad_chunk_samples, so the expected path is one chunk.
-        # clamp to B FIRST: an env column (T samples) is the smallest
-        # chunk this layout can make, and a start value above B would
-        # never find a divisor (the rounding loop below walks upward)
-        n_chunks = min(max(1, -(-(T * B) // grad_chunk_samples)), B)
-        while B % n_chunks:
-            n_chunks += 1
-        Bc = B // n_chunks
-
-        def chunk_loss(pp, chunk):
-            states_c, actions_c, rewards_c, dones_c, mu_lp_c, mu_v_c, boot_c = chunk
-            # one big forward over T*Bc + Bc states (conv batch stays
-            # MXU-sized; the bootstrap is valued under the TARGET policy)
-            flat = states_c.reshape((T * Bc, *states_c.shape[2:]))
-            all_states = jnp.concatenate([flat, boot_c], axis=0)
-            out = model.apply({"params": pp}, all_states)
-            logits = out.logits[: T * Bc].reshape((T, Bc, -1))
-            values = out.value[: T * Bc].reshape((T, Bc))
-            bootstrap_value = out.value[T * Bc:]
-
-            log_probs = jax.nn.log_softmax(logits, axis=-1)
-            probs = jax.nn.softmax(logits, axis=-1)
-            target_lp = jnp.take_along_axis(
-                log_probs, actions_c[..., None].astype(jnp.int32), axis=-1
-            )[..., 0]
-
-            vt = vtrace_returns(
-                behaviour_log_probs=mu_lp_c,
-                target_log_probs=jax.lax.stop_gradient(target_lp),
-                rewards=rewards_c,
-                dones=dones_c,
-                values=jax.lax.stop_gradient(values),
-                bootstrap_value=jax.lax.stop_gradient(bootstrap_value),
-                gamma=cfg.gamma,
-            )
-
-            # loss forms mirror ops/loss.py's a3c_loss (incl. the optional
-            # Huber value loss) so a lag-0 run optimizes the same objective
-            # as the fused step — at zero lag rho == c == 1 and the V-trace
-            # targets reduce exactly to the n-step returns.
-            policy_loss = -jnp.mean(target_lp * vt.pg_advantages)
-            if cfg.value_huber_delta is not None:
-                from distributed_ba3c_tpu.ops.symbolic import huber_loss
-
-                value_loss = jnp.mean(
-                    huber_loss(values - vt.vs, cfg.value_huber_delta)
-                )
-            else:
-                value_loss = 0.5 * jnp.mean(jnp.square(values - vt.vs))
-            entropy = -jnp.mean(jnp.sum(probs * log_probs, axis=-1))
-            total = (
-                policy_loss
-                + cfg.value_loss_coef * value_loss
-                - entropy_beta * entropy
-            )
-            aux = {
-                "loss": total,
-                "policy_loss": policy_loss,
-                "value_loss": value_loss,
-                "entropy": entropy,
-                "mean_rho": jnp.mean(vt.clipped_rhos),
-                "pred_value": jnp.mean(values),
-                # how far the value function moved across the policy lag —
-                # the observable the lag-1 correction story rests on (and
-                # it keeps every block input live in the compiled program)
-                "value_lag_mae": jnp.mean(
-                    jnp.abs(jax.lax.stop_gradient(values) - mu_v_c)
-                ),
-            }
-            return total, aux
-
-        def chunk_grad(pp, chunk):
-            return jax.value_and_grad(chunk_loss, has_aux=True)(pp, chunk)
-
-        def col_chunk(x):
-            # [T, B, ...] -> [n_chunks, T, Bc, ...] (chunk c = env columns
-            # c*Bc:(c+1)*Bc — matches boot.reshape(n_chunks, Bc) below)
-            return x.reshape(T, n_chunks, Bc, *x.shape[2:]).swapaxes(0, 1)
-
-        full_chunk = (
-            block.states, block.actions, block.rewards, block.dones,
-            block.behavior_log_probs, block.behavior_values,
-            block.bootstrap_state,
-        )
-        if n_chunks == 1:
-            (_, aux), grads = chunk_grad(params, full_chunk)
-        else:
-            boot_c = block.bootstrap_state.reshape(
-                n_chunks, Bc, *block.bootstrap_state.shape[1:]
-            )
-            chunks = (
-                col_chunk(block.states), col_chunk(block.actions),
-                col_chunk(block.rewards), col_chunk(block.dones),
-                col_chunk(block.behavior_log_probs),
-                col_chunk(block.behavior_values), boot_c,
-            )
-
-            def acc_body(carry, chunk):
-                g_acc, aux_acc = carry
-                (_, aux), g = chunk_grad(params, chunk)
-                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
-                aux_acc = jax.tree_util.tree_map(jnp.add, aux_acc, aux)
-                return (g_acc, aux_acc), None
-
-            first = jax.tree_util.tree_map(lambda x: x[0], chunks)
-            (_, aux0), g0 = chunk_grad(params, first)
-            rest = jax.tree_util.tree_map(lambda x: x[1:], chunks)
-            (grads, aux_sum), _ = jax.lax.scan(acc_body, (g0, aux0), rest)
-            grads = jax.tree_util.tree_map(lambda g: g / n_chunks, grads)
-            aux = jax.tree_util.tree_map(lambda a: a / n_chunks, aux_sum)
-        return grads, aux
-
-    def finish_update(train: TrainState, grads, aux, rewards, learning_rate):
-        """The learner tail — ONE definition for the single and macro
-        programs (psum + mean + LR injection + Adam + pmean'd metrics):
-        a tail fix applied to one copy must not silently diverge the
-        other (review finding)."""
-        grads = grad_allreduce(grads, DATA_AXIS)
-        n_data = axis_size(DATA_AXIS)
-        grads = jax.tree_util.tree_map(lambda g: g / n_data, grads)
-
-        opt_state = inject_learning_rate(train.opt_state, learning_rate)
-        updates, new_opt_state = optimizer.update(
-            grads, opt_state, train.params
-        )
-        new_params = optax.apply_updates(train.params, updates)
-        new_train = TrainState(
-            step=train.step + 1, params=new_params, opt_state=new_opt_state
-        )
-        metrics = {
-            **aux,
-            **grad_summaries(grads),
-            "reward_per_step": jnp.mean(rewards),
-        }
-        metrics = {k: jax.lax.pmean(v, DATA_AXIS) for k, v in metrics.items()}
-        return new_train, metrics
+    # the gradient body and the update tail are the module-level factories
+    # (make_block_grads / make_finish_update) shared with the pod's
+    # bounded-staleness learner — pure code motion, identical jaxprs, so
+    # the audit manifest's fused.* entries are unchanged
+    block_grads = make_block_grads(model, cfg, grad_chunk_samples)
+    finish_update = make_finish_update(optimizer)
 
     def local_learner(train: TrainState, block: TrajBlock, entropy_beta,
                       learning_rate):
@@ -672,4 +693,8 @@ def make_overlap_step(
     step.actor_jit = actor_jit
     step.learner_jit = learner_jit
     step.macro_learner_jit = macro_learner_jit
+    # the params-snapshot program: the pod's lagged driver
+    # (pod/learner.py LaggedBlockDriver) snapshots THROUGH this same
+    # program so its version ring never aliases learner-donated buffers
+    step.prep_jit = prep_jit
     return step

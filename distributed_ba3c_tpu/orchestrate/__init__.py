@@ -18,6 +18,12 @@ telemetry plane measures — ROADMAP open item 5:
 - :class:`ChaosMonkey` (orchestrate/chaos.py) — the acceptance harness's
   fault injector (scripts/chaos_bench.py gates on >=90% of no-chaos
   throughput under random SIGKILLs).
+- :class:`PodSupervisor` / :class:`PodLearnerPlane` (orchestrate/pod.py)
+  — pod mode: N supervised actor-host processes against one
+  bounded-staleness learner (docs/pod.md; ``--pod_hosts``).
+- :class:`MultihostLauncher` (orchestrate/multihost.py) — the retired
+  scripts/launch_multihost.sh loop: rank derivation + exit-75 relaunch
+  under the finalized-checkpoint resume gate (``--multihost``).
 
 Every decision is exported as ``tele/orchestrator/*`` series and
 flight-recorder events — scale/respawn/failover actions are always
@@ -36,6 +42,14 @@ from distributed_ba3c_tpu.orchestrate.chaos import ChaosMonkey  # noqa: F401
 from distributed_ba3c_tpu.orchestrate.learner import (  # noqa: F401
     LearnerSupervisor,
     finalized_step,
+)
+from distributed_ba3c_tpu.orchestrate.multihost import (  # noqa: F401
+    MultihostLauncher,
+)
+from distributed_ba3c_tpu.orchestrate.pod import (  # noqa: F401
+    PodLearnerPlane,
+    PodSupervisor,
+    host_argv,
 )
 from distributed_ba3c_tpu.orchestrate.spec import FleetSpec  # noqa: F401
 from distributed_ba3c_tpu.orchestrate.supervisor import (  # noqa: F401

@@ -1,4 +1,6 @@
-"""Operator entry point for learner checkpoint-failover:
+"""Operator entry point for the orchestration plane — three modes:
+
+**Learner checkpoint-failover** (default)::
 
     python -m distributed_ba3c_tpu.orchestrate \\
         --logdir runs/x --max_restarts 5 --stall_secs 300 -- \\
@@ -9,6 +11,24 @@ Everything after ``--`` goes to train.py verbatim (it must include
 supervisor adds it whenever a finalized checkpoint exists). This is
 scripts/run_with_resume.sh with the failover counted, flight-recorded
 and dumped (docs/orchestration.md).
+
+**Multi-host worker launch** (``--multihost``, retiring
+scripts/launch_multihost.sh — the shell script is now a shim onto this)::
+
+    python -m distributed_ba3c_tpu.orchestrate \\
+        --multihost "host1:9900,host2:9900" -- --logdir runs/x [...]
+
+Rank = SLURM_PROCID or this hostname's position in the list; exit 75
+(lost lockstep) relaunches under the same finalized-checkpoint resume
+gate the learner supervisor uses (orchestrate/multihost.py).
+
+**Pod mode** (``--pod_hosts N``, docs/pod.md): supervise N actor-host
+processes against one in-process bounded-staleness learner on the given
+tcp pipe base::
+
+    python -m distributed_ba3c_tpu.orchestrate --pod_hosts 2 \\
+        --pipe_c2s tcp://127.0.0.1:15555 --pipe_s2c tcp://127.0.0.1:15556 \\
+        --logdir runs/pod --updates 500
 """
 
 from __future__ import annotations
@@ -21,6 +41,49 @@ from distributed_ba3c_tpu import telemetry
 from distributed_ba3c_tpu.orchestrate.learner import LearnerSupervisor
 
 
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m distributed_ba3c_tpu.orchestrate",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--logdir", default=None, help="the run's logdir (same value train.py gets); required outside --multihost")
+    p.add_argument("--max_restarts", type=int, default=5)
+    p.add_argument(
+        "--stall_secs", type=float, default=0,
+        help="kill + resume when log.log stops moving for this long "
+        "(0 = crash-only failover, no stall watchdog)",
+    )
+    # -- multi-host mode ---------------------------------------------------
+    p.add_argument(
+        "--multihost", default=None, metavar="HOST1:P,HOST2:P",
+        help="run this host's worker rank of a multi-host training job "
+        "(rank from SLURM_PROCID or hostname position); train.py args "
+        "after '--'. Replaces scripts/launch_multihost.sh",
+    )
+    # -- pod mode (docs/pod.md) --------------------------------------------
+    p.add_argument(
+        "--pod_hosts", type=int, default=0,
+        help="pod mode: supervise N actor-host processes against one "
+        "in-process bounded-staleness learner (0 = off)",
+    )
+    p.add_argument("--pipe_c2s", default="tcp://127.0.0.1:15555", help="pod mode: base pipe pair the pod channels derive from (pod/wire.py)")
+    p.add_argument("--pipe_s2c", default="tcp://127.0.0.1:15556")
+    p.add_argument("--updates", type=int, default=0, help="pod mode: stop after this many learner updates (0 = run until interrupted)")
+    p.add_argument("--max_staleness", type=int, default=-1, help="pod mode: reject blocks more than this many params versions stale (-1 = measure only)")
+    p.add_argument("--publish_every", type=int, default=1, help="pod mode: publish params every N updates")
+    p.add_argument("--pod_env", default="fake", help="pod mode: each host's env (fake | cpp:<game>)")
+    p.add_argument("--pod_sims", type=int, default=4, help="pod mode: simulators (fake) / envs (cpp) per host")
+    p.add_argument("--pod_unroll_len", type=int, default=5)
+    p.add_argument("--pod_segments", type=int, default=16, help="pod mode: unroll segments per shipped block (the block's B)")
+    p.add_argument("--pod_image_size", type=int, default=84)
+    p.add_argument("--pod_frame_history", type=int, default=4)
+    p.add_argument("--pod_num_actions", type=int, default=4)
+    p.add_argument("--pod_fc_units", type=int, default=512)
+    p.add_argument("--pod_predict_batch_size", type=int, default=16)
+    return p
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if "--" in argv:
@@ -28,19 +91,35 @@ def main(argv: Optional[List[str]] = None) -> int:
         ours, train_args = argv[:split], argv[split + 1 :]
     else:
         ours, train_args = argv, []
-    p = argparse.ArgumentParser(
-        prog="python -m distributed_ba3c_tpu.orchestrate",
-        description=__doc__,
-        formatter_class=argparse.RawDescriptionHelpFormatter,
-    )
-    p.add_argument("--logdir", required=True, help="the run's logdir (same value train.py gets)")
-    p.add_argument("--max_restarts", type=int, default=5)
-    p.add_argument(
-        "--stall_secs", type=float, default=0,
-        help="kill + resume when log.log stops moving for this long "
-        "(0 = crash-only failover, no stall watchdog)",
-    )
+    p = make_parser()
     args = p.parse_args(ours)
+
+    if args.multihost and args.pod_hosts:
+        p.error("--multihost and --pod_hosts are different modes — pick one")
+
+    if args.multihost:
+        from distributed_ba3c_tpu.orchestrate.multihost import MultihostLauncher
+
+        if not train_args:
+            p.error("no train.py arguments after '--'")
+        return MultihostLauncher(args.multihost, train_args).run()
+
+    if args.pod_hosts:
+        from distributed_ba3c_tpu.orchestrate.pod import run_pod
+
+        if train_args:
+            # pod mode runs no train.py — silently ignoring these flags
+            # would measure a multi-hour capture on the wrong workload
+            p.error(
+                "pod mode takes no train.py arguments after '--' — the "
+                "pod's workload is shaped by the --pod_* flags"
+            )
+        if args.logdir:
+            telemetry.configure(args.logdir)
+        return run_pod(args)
+
+    if not args.logdir:
+        p.error("--logdir is required (it gates the stall watchdog and the resume path)")
     if not train_args:
         p.error("no train.py arguments after '--'")
     telemetry.configure(args.logdir)

@@ -1,0 +1,180 @@
+"""Multi-host worker launch: ``scripts/launch_multihost.sh`` as Python.
+
+The shell launcher carried three pieces of load-bearing logic — rank
+derivation (hostname position in the worker list, ``SLURM_PROCID``
+override), the exit-75 relaunch loop (parallel/watchdog.py's rank-failure
+semantics: a rank that loses lockstep exits 75 and must be relaunched
+with resume), and the finalized-checkpoint resume gate shared with
+``orchestrate/learner.py`` (resume ONLY from a checkpoint.json whose
+``latest`` is non-null; the run's own checkpoints take precedence over a
+caller warm start; a fresh first launch never silently resumes). All
+three now live here, counted and flight-recorded like every other
+orchestration decision; the shell script remains as a thin shim that
+warns and delegates (tests/test_launch_script.py pins the contract
+against whichever entry the operator uses).
+
+Entry point::
+
+    python -m distributed_ba3c_tpu.orchestrate \\
+        --multihost "host1:9900,host2:9900" -- --logdir runs/x [...]
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+from typing import List, Optional
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.orchestrate.learner import finalized_step
+from distributed_ba3c_tpu.utils import logger
+
+
+def rank_from_hosts(
+    worker_hosts: str, hostname: Optional[str] = None
+) -> int:
+    """This task's rank: ``SLURM_PROCID`` when set, else the position of
+    the short hostname in the worker list (the shell launcher's rule)."""
+    procid = os.environ.get("SLURM_PROCID")
+    if procid:
+        return int(procid)
+    short = (hostname or socket.gethostname()).split(".")[0]
+    hosts = [h.split(":")[0].split(".")[0] for h in worker_hosts.split(",")]
+    try:
+        return hosts.index(short)
+    except ValueError:
+        raise SystemExit(
+            f"hostname {short!r} not in --multihost list {hosts} and no "
+            "SLURM_PROCID set — cannot derive this task's rank"
+        )
+
+
+def _flag_value(args: List[str], name: str) -> Optional[str]:
+    """Last value of ``--name X`` / ``--name=X`` in an argv list."""
+    val = None
+    for i, a in enumerate(args):
+        if a == name and i + 1 < len(args):
+            val = args[i + 1]
+        elif a.startswith(name + "="):
+            val = a[len(name) + 1:]
+    return val
+
+
+def _strip_flag(args: List[str], name: str) -> List[str]:
+    out: List[str] = []
+    skip = False
+    for a in args:
+        if skip:
+            skip = False
+            continue
+        if a == name:
+            skip = True
+            continue
+        if a.startswith(name + "="):
+            continue
+        out.append(a)
+    return out
+
+
+class MultihostLauncher:
+    """One worker rank's supervised launch loop.
+
+    ``train_args`` go to train.py verbatim plus the worker identity
+    (``--job_name worker --worker_hosts ... --task_index <rank>``). Exit
+    75 (lost lockstep) relaunches with the resume gate:
+
+    - a FINALIZED run-local checkpoint (``<logdir>/checkpoints`` with
+      checkpoint.json ``latest`` non-null) wins — a caller ``--load`` is
+      a warm-START source and reusing it would replay every step since
+      launch forever (tests/test_launch_script.py);
+    - otherwise a caller ``--load`` is kept (warm start still the best
+      resume point before the first collective save);
+    - otherwise relaunch fresh. The FIRST launch never auto-resumes even
+      over a reused logdir (a silent resume could "complete" a finished
+      run with zero training).
+
+    Any other exit code propagates.
+    """
+
+    def __init__(
+        self,
+        worker_hosts: str,
+        train_args: List[str],
+        task_index: Optional[int] = None,
+        train_py: str = "train.py",
+        python: Optional[str] = None,
+    ):
+        self.worker_hosts = worker_hosts
+        self.train_args = list(train_args)
+        self.task_index = (
+            rank_from_hosts(worker_hosts) if task_index is None else task_index
+        )
+        # CWD-relative by default, like the shell launcher — operators run
+        # it from the repo root and the launch-script tests stub train.py
+        # in their working directory
+        self.train_py = train_py
+        self.python = python or sys.executable
+        self.logdir = _flag_value(self.train_args, "--logdir") or ""
+        tele = telemetry.registry("orchestrator")
+        self._c_relaunches = tele.counter("multihost_relaunches_total")
+        self._flight = telemetry.flight_recorder()
+
+    def _resume_args(self) -> List[str]:
+        """The relaunch argv under the resume gate (see class docstring)."""
+        args = list(self.train_args)
+        run_ckpts = os.path.join(self.logdir, "checkpoints")
+        if self.logdir and finalized_step(run_ckpts) is not None:
+            if _flag_value(args, "--load") is not None:
+                logger.warn(
+                    "[multihost] resume: replacing caller --load with the "
+                    "run's own %s (progress since launch lives there)",
+                    run_ckpts,
+                )
+                args = _strip_flag(args, "--load")
+            return args + ["--load", run_ckpts]
+        if _flag_value(args, "--load") is not None:
+            logger.warn(
+                "[multihost] exit 75, no run-local checkpoint saved yet — "
+                "retrying with the caller's --load (warm start)"
+            )
+            return args
+        logger.warn(
+            "[multihost] exit 75 but no saved checkpoint to resume from "
+            "(logdir=%r) — relaunching fresh", self.logdir,
+        )
+        return args
+
+    def run(self) -> int:
+        logger.info(
+            "[multihost] worker_hosts=%s task_index=%d",
+            self.worker_hosts, self.task_index,
+        )
+        relaunch = False
+        while True:
+            args = self._resume_args() if relaunch else list(self.train_args)
+            argv = [
+                self.python, self.train_py,
+                "--job_name", "worker",
+                "--worker_hosts", self.worker_hosts,
+                "--task_index", str(self.task_index),
+            ] + args
+            rc = subprocess.call(argv)
+            if rc != 75:
+                return rc
+            relaunch = True
+            self._c_relaunches.inc()
+            self._flight.record(
+                "multihost_relaunch",
+                task_index=self.task_index,
+                resume_step=finalized_step(
+                    os.path.join(self.logdir, "checkpoints")
+                )
+                if self.logdir
+                else None,
+            )
+            logger.warn(
+                "[multihost] rank lost lockstep (exit 75) — relaunching "
+                "with resume"
+            )

@@ -1,0 +1,57 @@
+"""Pod-scale async training: the bounded-staleness parameter plane.
+
+The reference paper's whole scaling story is asynchronous distribution —
+64 CPU nodes pushing gradients through parameter servers with actor/
+learner staleness tolerated *silently* (Adamski et al., arXiv:1801.02852).
+This package composes the pieces the repo already proved separately (tcp
+fleet pipes, the ``prep`` params-snapshot decoupling point from
+fused/overlap.py, the supervised actor plane, cross-host telemetry) into
+the IMPALA-shaped system (Espeholt et al. 2018) that PS cluster
+approximated — with the staleness *measured and corrected* instead:
+
+- **params broadcast** (publisher.py / cache.py): the learner publishes
+  versioned snapshots over a ZMQ PUB + ROUTER side-channel derived from
+  the fleet port map (wire.py); each actor host serves its predictor from
+  a :class:`StaleParamsCache` that refreshes asynchronously with
+  retry/backoff and never blocks rollout on a fetch.
+- **bounded-staleness learner** (learner.py): experience blocks arrive
+  stamped with the params version they were collected under; V-trace
+  corrects the per-block *measured* lag (behavior log-probs ride in the
+  block, so the correction is exact at any lag — the fixed lag-1 of
+  fused/overlap.py generalized), a :class:`StalenessGate` rejects blocks
+  beyond ``--max_staleness`` with a typed counter, and ``value_lag_mae``
+  plus the per-block ``params_lag`` histogram are first-class SLO gauges.
+- **actor host** (host.py): a complete plane per host — supervised env
+  servers, master, predictor-from-cache, experience shipper — run as one
+  process ``python -m distributed_ba3c_tpu.pod.host``; orchestrated N at
+  a time by ``orchestrate/pod.py``.
+
+docs/pod.md documents the wire protocol, the version-stamp format and the
+staleness semantics; scripts/pod_bench.py measures the scaling story.
+"""
+
+from __future__ import annotations
+
+from distributed_ba3c_tpu.pod.wire import (  # noqa: F401
+    EXPERIENCE_KEYS,
+    PodEndpoints,
+    pack_experience,
+    pack_params,
+    pod_endpoints,
+    pod_role,
+    unpack_experience,
+    unpack_params,
+)
+from distributed_ba3c_tpu.pod.publisher import ParamsPublisher  # noqa: F401
+from distributed_ba3c_tpu.pod.cache import (  # noqa: F401
+    StaleParamsCache,
+    VersionGatedPredictor,
+)
+from distributed_ba3c_tpu.pod.ingest import PodIngest, StampedBatch  # noqa: F401
+from distributed_ba3c_tpu.pod.learner import (  # noqa: F401
+    LaggedBlockDriver,
+    PodLearner,
+    StalenessGate,
+    batch_to_block,
+    make_pod_learner_step,
+)

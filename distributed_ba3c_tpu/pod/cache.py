@@ -1,0 +1,309 @@
+"""StaleParamsCache: the actor host's end of the params plane.
+
+The pod's core asymmetry (docs/pod.md): rollout NEVER waits for
+parameters. The cache serves the predictor from the last version it
+received; a refresh thread subscribes to the learner's broadcasts and,
+when it holds nothing yet (fresh spawn, respawn after a host-loss chaos
+kill), fetches the current snapshot with retry/backoff over the ROUTER
+side-channel. Staleness is therefore a *measured* property of the
+experience (every shipped block is stamped with ``cache.version``), not a
+synchronization point — exactly the IMPALA inversion of the reference's
+blocking parameter-server pull.
+
+:class:`VersionGatedPredictor` is the host-side half of the
+``--max_staleness`` bound: when the cache KNOWS it has fallen more than
+the bound behind the latest *seen* version (broadcasts arriving faster
+than the predictor swap can apply them, or a wedged apply callback), new
+predict tasks are shed with a typed :class:`~distributed_ba3c_tpu.predict
+.server.ShedReject` — the masters answer sheds with the true
+uniform-random fallback policy, so the lockstep env servers keep stepping
+(never parked in ``recv()``) and the behavior log-probs stay exact for
+V-trace. Blocks the host cannot know are over-stale (a silent partition)
+are caught by the learner-side :class:`~distributed_ba3c_tpu.pod.learner
+.StalenessGate`, where version truth lives.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+import zmq
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.pod.wire import PodEndpoints, pod_role, unpack_params
+from distributed_ba3c_tpu.utils import logger
+from distributed_ba3c_tpu.utils.concurrency import StoppableThread
+
+
+class StaleParamsCache:
+    """Hold the last received params version; refresh asynchronously.
+
+    ``on_update(params, version)`` callbacks run on the refresh thread —
+    the sanctioned versioned publish path into a predictor
+    (``predictor.update_params`` is an atomic ref swap, so the rollout
+    thread never observes a torn update). ba3clint rule A10 flags
+    update_params calls anywhere OUTSIDE this plane precisely so no code
+    path can bypass the version accounting silently.
+    """
+
+    def __init__(
+        self,
+        endpoints: PodEndpoints,
+        host: int = 0,
+        fetch_backoff_s: float = 0.2,
+        fetch_backoff_max_s: float = 5.0,
+        tele_role: Optional[str] = None,
+    ):
+        self.endpoints = endpoints
+        self.host = int(host)
+        self._backoff0 = fetch_backoff_s
+        self._backoff_max = fetch_backoff_max_s
+        self._params: Optional[Dict[str, Any]] = None
+        self.version = -1  # nothing received yet
+        self.seen_version = -1  # newest version observed on the wire
+        self.epoch: Optional[int] = None  # publisher lifetime adopted
+        self.learner_step = 0
+        self._have_first = threading.Event()
+        self._callbacks: list = []
+        self._lock = threading.Lock()
+
+        role = tele_role or pod_role(host)
+        self.tele_role = role
+        tele = telemetry.registry(role)
+        self._c_refreshes = tele.counter("params_refreshes_total")
+        self._c_retries = tele.counter("params_fetch_retries_total")
+        self._c_malformed = tele.counter("params_malformed_total")
+        self._g_version = tele.gauge("params_version")
+        self._g_behind = tele.gauge("params_behind", fn=self.behind)
+
+        self.context = zmq.Context()
+        self._sub = self.context.socket(zmq.SUB)
+        self._sub.setsockopt(zmq.LINGER, 0)
+        self._sub.setsockopt(zmq.SUBSCRIBE, b"")
+        # keep at most a couple of snapshots queued: applying the NEWEST
+        # is all that matters, backlog is just memory
+        self._sub.set_hwm(2)
+        self._sub.connect(endpoints.params_pub)
+        self._dealer = self.context.socket(zmq.DEALER)
+        self._dealer.setsockopt(zmq.LINGER, 0)
+        self._dealer.connect(endpoints.params_fetch)
+
+        self._thread = StoppableThread(
+            target=self._refresh_loop, daemon=True,
+            name=f"pod-params-cache-h{host}",
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._thread.stop()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    def close(self) -> None:
+        self.stop()
+        self.join(timeout=2)
+        for s in (self._sub, self._dealer):
+            try:
+                s.close(0)
+            except zmq.ZMQError:
+                pass
+        self.context.term()
+
+    # -- the serving surface ------------------------------------------------
+    @property
+    def params(self) -> Optional[Dict[str, Any]]:
+        return self._params
+
+    def behind(self) -> int:
+        """How many versions the APPLIED params trail the newest version
+        seen on the wire (0 when current; 0 before the first receive —
+        a host that has seen nothing cannot claim a measured lag)."""
+        return max(0, self.seen_version - self.version)
+
+    def on_update(self, cb: Callable[[Any, int], None]) -> None:
+        """Register a callback for every applied refresh (refresh-thread
+        context). Registered AFTER a first version arrived, the callback
+        fires immediately with the current params — a predictor built
+        from ``wait_first`` must not miss the version it was built at."""
+        with self._lock:
+            self._callbacks.append(cb)
+            p, v = self._params, self.version
+        if p is not None:
+            cb(p, v)
+
+    def wait_first(self, timeout: Optional[float] = None) -> bool:
+        """Block (caller's thread, NOT rollout) until the first snapshot
+        lands; the ONE sanctioned wait in the pod host's startup path —
+        there is nothing to roll out before any policy exists."""
+        return self._have_first.wait(timeout)
+
+    # -- refresh internals ---------------------------------------------------
+    def _apply(self, payload) -> None:
+        epoch, version, step, params = unpack_params(payload)
+        if epoch != self.epoch:
+            # a NEW publisher lifetime (first contact, or a restarted
+            # learner whose versions regressed to 0): adopt it outright —
+            # version ordering only means anything WITHIN an epoch, and
+            # refusing the "older" number would freeze this host on the
+            # dead lineage's policy forever
+            self.epoch = epoch
+            self.seen_version = version
+        else:
+            self.seen_version = max(self.seen_version, version)
+            if version <= self.version:
+                return  # stale broadcast (fetch raced a publish)
+        with self._lock:
+            self._params = params
+            self.version = version
+            self.learner_step = step
+            cbs = list(self._callbacks)
+        for cb in cbs:
+            try:
+                cb(params, version)
+            except Exception as e:  # a bad consumer must not kill refresh
+                logger.error("params cache on_update raised %r", e)
+        self._c_refreshes.inc()
+        self._g_version.set(version)
+        self._have_first.set()
+
+    def _refresh_loop(self) -> None:
+        import time
+
+        t = threading.current_thread()
+        assert isinstance(t, StoppableThread)
+        poller = zmq.Poller()
+        poller.register(self._sub, zmq.POLLIN)
+        poller.register(self._dealer, zmq.POLLIN)
+        backoff = self._backoff0
+        next_fetch = 0.0  # monotonic time of the next fetch (re)attempt
+        first_attempt = True
+        while not t.stopped():
+            now = time.monotonic()
+            if self._params is None and now >= next_fetch:
+                # the late-joiner path: ask the ROUTER for the current
+                # snapshot instead of waiting out a publish interval. A
+                # request that gets no (or an empty) reply inside the
+                # backoff window is simply re-sent — DEALER sends never
+                # block rollout, and the monkey killing a host mid-run is
+                # exactly this path on the respawn side.
+                try:
+                    self._dealer.send(b"fetch", zmq.NOBLOCK)
+                except zmq.ZMQError:
+                    pass
+                if not first_attempt:
+                    self._c_retries.inc()
+                first_attempt = False
+                next_fetch = now + backoff
+                backoff = min(self._backoff_max, backoff * 2)
+            try:
+                events = dict(poller.poll(100))
+                if self._dealer in events:
+                    reply = self._dealer.recv()
+                    if reply and self._apply_safe(reply):
+                        backoff = self._backoff0
+                if self._sub in events:
+                    self._apply_safe(self._sub.recv())
+            except (zmq.ContextTerminated, zmq.ZMQError):
+                return
+
+    def _apply_safe(self, payload) -> bool:
+        """Apply one payload; a malformed frame (port-band collision,
+        learner/host message-format skew) must COUNT and keep the refresh
+        loop alive, not kill the one thread that could ever recover —
+        same contract as PodIngest's malformed-block handling."""
+        try:
+            self._apply(payload)
+            return True
+        except Exception as e:  # msgpack raises its own hierarchy too
+            self._c_malformed.inc()
+            logger.error(
+                "pod params cache dropped a malformed payload: %r", e
+            )
+            return False
+
+
+class VersionGatedPredictor:
+    """Shed predict tasks when the cache is provably over-stale.
+
+    Wraps a :class:`~distributed_ba3c_tpu.predict.server.BatchedPredictor`
+    surface (put_task / put_block_task / num_actions). When
+    ``behind_fn() > max_staleness`` the task is answered immediately with
+    a typed ``ShedReject("stale_params")`` through its shed callback — the
+    masters' uniform-fallback path keeps every lockstep server stepping,
+    and the recorded uniform log-prob keeps V-trace exact. The learner
+    would have rejected blocks collected this far behind anyway; shedding
+    here spends zero device time producing them.
+    """
+
+    def __init__(
+        self,
+        predictor,
+        behind_fn: Callable[[], int],
+        max_staleness: int,
+        tele_role: str = "pod.host0",
+    ):
+        self._pred = predictor
+        self._behind = behind_fn
+        self.max_staleness = int(max_staleness)
+        self._c_stale_sheds = telemetry.registry(tele_role).counter(
+            "stale_params_sheds_total"
+        )
+
+    @property
+    def num_actions(self) -> int:
+        return self._pred.num_actions
+
+    def update_params(self, params, policy: str = "default") -> None:
+        # versioned path only: the cache's on_update is the publisher into
+        # the wrapped predictor (sanctioned A10 site — inside pod/)
+        self._pred.update_params(params, policy=policy)
+
+    def _stale(self) -> bool:
+        return self._behind() > self.max_staleness
+
+    def _shed(self, k: int, shed_callback) -> bool:
+        from distributed_ba3c_tpu.predict.server import ShedReject
+
+        self._c_stale_sheds.inc(k)
+        if shed_callback is not None:
+            shed_callback(ShedReject("stale_params"))
+        return False
+
+    def put_task(self, state, callback, *, shed_callback=None, **kw) -> bool:
+        if self._stale():
+            return self._shed(1, shed_callback)
+        return self._pred.put_task(
+            state, callback, shed_callback=shed_callback, **kw
+        )
+
+    def put_block_task(
+        self, states: np.ndarray, callback, *, shed_callback=None, **kw
+    ) -> bool:
+        if self._stale():
+            return self._shed(int(states.shape[0]), shed_callback)
+        return self._pred.put_block_task(
+            states, callback, shed_callback=shed_callback, **kw
+        )
+
+    def predict_batch(self, states):
+        return self._pred.predict_batch(states)
+
+    # lifecycle passthrough (StartProcOrThread protocol)
+    def start(self) -> None:
+        self._pred.start()
+
+    def stop(self) -> None:
+        self._pred.stop()
+
+    def join(self, timeout=None) -> None:
+        self._pred.join(timeout)
+
+    def warmup(self, state_shape, dtype=np.uint8) -> None:
+        self._pred.warmup(state_shape, dtype)

@@ -1,0 +1,173 @@
+"""PodIngest: the learner's experience intake from N actor hosts.
+
+One PULL socket, one receive thread, one bounded drop-oldest buffer. The
+drop-oldest policy IS the pod's backpressure story (docs/pod.md): actor
+hosts never slow down because the learner fell behind — a backed-up
+learner consumes the NEWEST experience and sheds the oldest (counted, so
+the series shows it), which in bounded-staleness terms converts learner
+lag into measured params lag instead of wedging the whole pod on a full
+queue. The reference's PS cluster had the same property by accident
+(silently dropped async updates); here it is a typed counter.
+
+Each received block also piggybacks the sending host's progress scalars
+(the cross-host analogue of telemetry/wire.py's fleet deltas): the ingest
+folds them into the learner-process ``pod.host<k>`` registries as gauges,
+so per-host progress and failure attribution survive on the LEARNER'S
+scrape endpoint — the satellite fix in telemetry/exporters.py makes
+export_scalars carry those roles into stat.json/TB.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+import zmq
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.pod.wire import (
+    PodEndpoints,
+    pod_role,
+    unpack_experience,
+)
+from distributed_ba3c_tpu.utils.concurrency import StoppableThread
+
+
+@dataclasses.dataclass
+class StampedBatch:
+    """One host-shipped rollout batch with its staleness provenance."""
+
+    host: int
+    version: int  # params version the block was COLLECTED under
+    batch: Dict[str, np.ndarray]
+    #: publisher lifetime the version counts within (0 = unknown/legacy);
+    #: the learner rejects blocks from a lineage it does not own
+    epoch: int = 0
+
+
+class PodIngest:
+    """Bind the experience channel and buffer stamped batches.
+
+    ``next_batch(timeout)`` returns the OLDEST buffered
+    :class:`StampedBatch` (FIFO within the bound); when the buffer is full
+    the receive thread drops the oldest instead of stalling the socket —
+    ``pod_ingest_dropped_total`` counts what the learner never saw.
+    """
+
+    def __init__(
+        self,
+        endpoints: PodEndpoints,
+        depth: int = 16,
+        tele_role: str = "learner",
+    ):
+        self.endpoints = endpoints
+        self.context = zmq.Context()
+        self._pull = self.context.socket(zmq.PULL)
+        self._pull.setsockopt(zmq.LINGER, 0)
+        self._pull.set_hwm(max(4, depth))
+        self._pull.bind(endpoints.experience)
+        self._buf: collections.deque = collections.deque()
+        self._depth = max(1, int(depth))
+        self._lock = threading.Lock()
+        self._ready = threading.Condition(self._lock)
+
+        tele = telemetry.registry(tele_role)
+        self._c_blocks = tele.counter("pod_ingest_blocks_total")
+        self._c_steps = tele.counter("pod_ingest_env_steps_total")
+        self._c_dropped = tele.counter("pod_ingest_dropped_total")
+        self._g_depth = tele.gauge(
+            "pod_ingest_depth", fn=lambda: len(self._buf)
+        )
+        self._host_gauges: Dict[int, Dict[str, object]] = {}
+
+        self._thread = StoppableThread(
+            target=self._recv_loop, daemon=True, name="pod-ingest"
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._thread.stop()
+        with self._ready:
+            self._ready.notify_all()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    def close(self) -> None:
+        self.stop()
+        self.join(timeout=2)
+        try:
+            self._pull.close(0)
+        except zmq.ZMQError:
+            pass
+        self.context.term()
+
+    # -- consumption -------------------------------------------------------
+    def next_batch(self, timeout: Optional[float] = None) -> Optional[StampedBatch]:
+        """Oldest buffered batch, or None on timeout/stop (the caller's
+        feed-timeout turns a silent pod into a loud failure, same contract
+        as the dataflow feeds)."""
+        with self._ready:
+            if not self._buf:
+                self._ready.wait(timeout)
+            if not self._buf:
+                return None
+            return self._buf.popleft()
+
+    def qsize(self) -> int:
+        return len(self._buf)
+
+    # -- receive internals ---------------------------------------------------
+    def _fold_host_scalars(self, host: int, scalars: Dict[str, float]) -> None:
+        """Mirror the host's shipped progress counters as learner-process
+        gauges under its ``pod.host<k>`` role (absolute values — the host
+        owns the counting; the learner just re-exports the latest)."""
+        gauges = self._host_gauges.setdefault(host, {})
+        reg = telemetry.registry(pod_role(host))
+        for name, v in scalars.items():
+            g = gauges.get(name)
+            if g is None:
+                gauges[name] = g = reg.gauge(name)
+            try:
+                g.set(float(v))
+            except (TypeError, ValueError):
+                pass
+
+    def _recv_loop(self) -> None:
+        t = threading.current_thread()
+        assert isinstance(t, StoppableThread)
+        poller = zmq.Poller()
+        poller.register(self._pull, zmq.POLLIN)
+        while not t.stopped():
+            try:
+                if not poller.poll(100):
+                    continue
+                frames = self._pull.recv_multipart(copy=False)
+            except (zmq.ContextTerminated, zmq.ZMQError):
+                return
+            try:
+                host, epoch, version, scalars, batch = unpack_experience(
+                    [f.buffer for f in frames]
+                )
+            except (ValueError, KeyError, TypeError) as e:
+                from distributed_ba3c_tpu.utils import logger
+
+                logger.error("pod ingest dropped a malformed block: %r", e)
+                continue
+            T, B = batch["action"].shape
+            self._c_blocks.inc()
+            self._c_steps.inc(T * B)
+            self._fold_host_scalars(host, scalars)
+            with self._ready:
+                if len(self._buf) >= self._depth:
+                    self._buf.popleft()
+                    self._c_dropped.inc()
+                self._buf.append(StampedBatch(host, version, batch, epoch))
+                self._ready.notify()

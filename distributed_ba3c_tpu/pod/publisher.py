@@ -1,0 +1,146 @@
+"""ParamsPublisher: the learner's versioned-snapshot broadcast service.
+
+The ``prep`` snapshot program (fused/overlap.py) already proved the
+decoupling point: the learner's donated param buffers must never be read
+by anyone else, so every publish starts from a COPY. This class is that
+decoupling pushed across the process boundary — the pod's replacement for
+the reference's parameter-server pull (SURVEY.md §3.4), with the roles
+inverted: the learner PUSHES versioned snapshots, actor hosts keep a
+stale cache (pod/cache.py), and nobody ever blocks a training step on a
+parameter round-trip.
+
+Two sockets, one contract (docs/pod.md):
+
+- PUB: every :meth:`publish` broadcasts the full ``pack_params`` payload.
+  PUB drops for slow/absent subscribers by design — a host that misses a
+  broadcast stays on its last version, which is exactly the bounded-
+  staleness semantics the learner's gate measures and enforces.
+- ROUTER: answers ``[b"fetch"]`` requests with the LATEST payload (or an
+  empty frame before the first publish) — the late-joiner/rejoin path a
+  respawned host's cache retries with backoff. Served by a small
+  StoppableThread; the latest payload is an atomic ref swap away from the
+  publishing thread.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import zmq
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.pod.wire import PodEndpoints, pack_params
+from distributed_ba3c_tpu.utils import logger
+from distributed_ba3c_tpu.utils.concurrency import StoppableThread
+
+
+class ParamsPublisher:
+    """Bind the pod params channels and serve versioned snapshots.
+
+    Satisfies the StartProcOrThread protocol (start/stop/join/close) so a
+    learner assembly can append it to its startables list.
+    """
+
+    def __init__(
+        self,
+        endpoints: PodEndpoints,
+        tele_role: str = "learner",
+        epoch: Optional[int] = None,
+    ):
+        self.endpoints = endpoints
+        # the epoch names THIS publisher lifetime: a relaunched learner's
+        # versions restart at 0, and without it every surviving cache
+        # would drop the "older" broadcasts forever (pod/wire.py)
+        self.epoch = (
+            int.from_bytes(os.urandom(4), "little") if epoch is None
+            else int(epoch)
+        )
+        self.context = zmq.Context()
+        self._pub = self.context.socket(zmq.PUB)
+        self._pub.setsockopt(zmq.LINGER, 0)
+        # a slow subscriber sheds broadcasts instead of ballooning the
+        # learner's memory: the fetch channel is the catch-up path
+        self._pub.set_hwm(4)
+        self._pub.bind(endpoints.params_pub)
+        self._router = self.context.socket(zmq.ROUTER)
+        self._router.setsockopt(zmq.LINGER, 0)
+        self._router.bind(endpoints.params_fetch)
+        self._latest: Optional[bytes] = None  # atomic ref swap
+        self.version = 0
+
+        tele = telemetry.registry(tele_role)
+        self._c_publishes = tele.counter("pod_params_publishes_total")
+        self._c_fetches = tele.counter("pod_params_fetches_total")
+        self._g_version = tele.gauge("pod_params_version")
+
+        self._thread = StoppableThread(
+            target=self._serve_fetches, daemon=True, name="pod-params-fetch"
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._thread.start()
+        logger.info(
+            "pod params plane up: pub %s, fetch %s",
+            self.endpoints.params_pub, self.endpoints.params_fetch,
+        )
+
+    def stop(self) -> None:
+        self._thread.stop()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread.is_alive():
+            self._thread.join(timeout)
+
+    def close(self) -> None:
+        self.stop()
+        self.join(timeout=2)
+        for s in (self._pub, self._router):
+            try:
+                s.close(0)
+            except zmq.ZMQError:
+                pass
+        self.context.term()
+
+    # -- the publish path --------------------------------------------------
+    def publish(self, version: int, params: Any, step: Optional[int] = None) -> None:
+        """Broadcast one versioned snapshot (and arm the fetch channel).
+
+        ``params`` must already be host-side and learner-decoupled (the
+        caller device_gets its own snapshot — this class never touches
+        donated device buffers; see PodLearner.publish for the sanctioned
+        sequence)."""
+        payload = pack_params(version, params, step=step, epoch=self.epoch)
+        self._latest = payload
+        self.version = int(version)
+        self._g_version.set(self.version)
+        self._c_publishes.inc()
+        try:
+            self._pub.send(payload, zmq.NOBLOCK)
+        except zmq.Again:
+            # every subscriber is beyond its HWM: they stay stale and the
+            # fetch channel (or the next publish) catches them up
+            pass
+
+    def _serve_fetches(self) -> None:
+        import threading
+
+        t = threading.current_thread()
+        assert isinstance(t, StoppableThread)
+        poller = zmq.Poller()
+        poller.register(self._router, zmq.POLLIN)
+        while not t.stopped():
+            try:
+                if not poller.poll(200):
+                    continue
+                frames = self._router.recv_multipart()
+            except (zmq.ContextTerminated, zmq.ZMQError):
+                return
+            ident = frames[0]
+            latest = self._latest
+            self._c_fetches.inc()
+            try:
+                self._router.send_multipart([ident, latest or b""])
+            except zmq.ZMQError:
+                pass  # requester went away; it will retry with backoff

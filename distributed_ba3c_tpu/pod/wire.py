@@ -1,0 +1,215 @@
+"""Pod wire: endpoint derivation + the version-stamp message formats.
+
+Three channels per pod, all derived from the learner's base pipe pair the
+same way ``actors/fleet.py fleet_pipes`` derives per-fleet experience
+pipes — addressing, not new machinery (docs/pod.md):
+
+- **params PUB** (learner binds, hosts SUB): every publish broadcasts the
+  full versioned snapshot; a slow or partitioned host simply misses
+  broadcasts and stays on its last version (bounded staleness is the
+  learner's job, not the transport's).
+- **params fetch** (learner ROUTER, hosts DEALER): the late-joiner path —
+  a freshly (re)spawned host asks for the CURRENT snapshot instead of
+  waiting out a publish interval; retried with backoff by the cache.
+- **experience PUSH/PULL** (hosts PUSH, learner PULL): collated [T, B]
+  rollout batches stamped with the params version they were collected
+  under, plus a piggybacked host-telemetry snapshot (the cross-host
+  analogue of telemetry/wire.py's fleet deltas).
+
+tcp:// base pipes step the port by ``POD_PORT_OFFSET + i`` — far above
+the ``2 * fleet`` stride the fleet map uses, so the two derivations can
+never collide for any sane fleet count (validated at derivation); every
+other transport gets a path suffix, exactly the fleet_pipes idiom.
+
+Version-stamp format: the version is the learner's update counter at
+publish time — a single monotonically increasing int — and the **epoch**
+is a random token minted once per ParamsPublisher lifetime. The epoch is
+what makes a learner RESTART detectable: a relaunched learner's versions
+restart at 0, and without the epoch every surviving cache would silently
+drop the "older" broadcasts forever while the clamped lag read 0 — the
+exact silent staleness this plane exists to prevent. A params message is
+``dumps({"e": epoch, "v": version, "step": learner_step, "params":
+<nested dict of ndarrays>})``; an experience message is a ``pack_block``
+multipart whose header meta is ``{"host": k, "e": epoch, "v": stamp,
+"scalars": {...}}`` and whose array frames are :data:`EXPERIENCE_KEYS`
+in order (zero-copy both ways, the block wire's codec).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from distributed_ba3c_tpu.utils.serialize import (
+    dumps,
+    loads,
+    pack_block,
+    unpack_block,
+)
+
+_TCP_RE = re.compile(r"^(tcp://[^:]+:)(\d+)$")
+
+#: tcp port offset of the first pod channel relative to the base c2s port.
+#: Far above the fleet map's ``2 * fleet`` stride (fleet_pipes) — a 50-fleet
+#: learner would be needed to collide, and :func:`pod_endpoints` validates.
+POD_PORT_OFFSET = 100
+
+#: the experience frames' array order (the header carries no per-array
+#: names — order IS the schema, docs/pod.md)
+EXPERIENCE_KEYS = (
+    "state",
+    "action",
+    "reward",
+    "done",
+    "behavior_log_probs",
+    "behavior_values",
+    "bootstrap_state",
+)
+
+
+def pod_role(host: int) -> str:
+    """The canonical telemetry role for one actor host's plane: THE single
+    formula (like ``telemetry.fleet_role``) both the host process and the
+    learner-side ingest fold use — deriving it twice would let the host's
+    own registries and the learner's per-host mirror drift apart."""
+    return f"pod.host{int(host)}"
+
+
+@dataclasses.dataclass(frozen=True)
+class PodEndpoints:
+    """The learner's three pod channel addresses (hosts connect to all)."""
+
+    params_pub: str
+    params_fetch: str
+    experience: str
+
+
+def pod_endpoints(
+    pipe_c2s: str, pipe_s2c: str, n_fleets: int = 1
+) -> PodEndpoints:
+    """Derive the pod side-channel addresses from the base pipe pair.
+
+    ``n_fleets`` is the learner's fleet count: the fleet map occupies tcp
+    ports ``base .. base + 2 * n_fleets`` (fleet_pipes), and the pod
+    channels must land strictly above it — an overlap would double-bind a
+    fleet's experience pipe as a params channel and fail only at runtime.
+    """
+    if n_fleets >= 1 and 2 * n_fleets >= POD_PORT_OFFSET:
+        raise ValueError(
+            f"{n_fleets} fleets span {2 * n_fleets} ports from the base "
+            f"pipe — the pod channels start at +{POD_PORT_OFFSET} and "
+            "would collide; rebase the pod learner's pipe pair"
+        )
+    m = _TCP_RE.match(pipe_c2s)
+    if m:
+        host, port = m.group(1), int(m.group(2))
+        return PodEndpoints(
+            params_pub=f"{host}{port + POD_PORT_OFFSET}",
+            params_fetch=f"{host}{port + POD_PORT_OFFSET + 1}",
+            experience=f"{host}{port + POD_PORT_OFFSET + 2}",
+        )
+    # ipc:///inproc:// — suffix the c2s path (the s2c pair member exists
+    # only so callers can hand the whole pipe pair through unchanged)
+    return PodEndpoints(
+        params_pub=f"{pipe_c2s}-pod-pub",
+        params_fetch=f"{pipe_c2s}-pod-fetch",
+        experience=f"{pipe_c2s}-pod-exp",
+    )
+
+
+def _plain(tree: Any) -> Any:
+    """Param pytree → msgpack-serializable nested dict of ndarrays (flax
+    FrozenDict included — it is a Mapping)."""
+    if isinstance(tree, Mapping):
+        return {k: _plain(v) for k, v in tree.items()}
+    return np.asarray(tree)
+
+
+def pack_params(
+    version: int, params: Any, step: Optional[int] = None, epoch: int = 0
+) -> bytes:
+    """One params snapshot message (PUB broadcast == fetch reply)."""
+    return dumps(
+        {
+            "e": int(epoch),
+            "v": int(version),
+            "step": int(step or 0),
+            "params": _plain(params),
+        }
+    )
+
+
+def unpack_params(payload) -> Tuple[int, int, int, Dict[str, Any]]:
+    """Inverse of :func:`pack_params`: ``(epoch, version, step, params)``.
+    The arrays are COPIES (not buffer views): the cache hands them to a
+    predictor that outlives the zmq frame."""
+    doc = loads(payload)
+    params = _copy_tree(doc["params"])
+    return (
+        int(doc.get("e", 0)),
+        int(doc["v"]),
+        int(doc.get("step", 0)),
+        params,
+    )
+
+
+def _copy_tree(tree: Any) -> Any:
+    if isinstance(tree, Mapping):
+        return {k: _copy_tree(v) for k, v in tree.items()}
+    return np.array(tree)  # own the memory past the zmq frame's life
+
+
+def pack_experience(
+    host: int,
+    version: int,
+    batch: Dict[str, np.ndarray],
+    scalars: Optional[Dict[str, float]] = None,
+    epoch: int = 0,
+) -> List[Any]:
+    """One stamped experience block as a zero-copy multipart message.
+
+    ``batch`` is the collated [T, B] rollout batch (collate_rollout layout
+    plus ``behavior_values``); ``version`` is the OLDEST params version
+    any of the block's transitions could have been served under (the
+    cache's version when the block's FIRST segment was banked — the
+    conservative stamp the bounded-staleness gate measures lag from);
+    ``epoch`` is the publisher lifetime the version counts within;
+    ``scalars`` piggybacks the host's progress counters for the
+    learner-side ``pod.host<k>`` mirror.
+    """
+    missing = [k for k in EXPERIENCE_KEYS if k not in batch]
+    if missing:
+        raise ValueError(f"experience batch missing keys {missing}")
+    meta = {
+        "host": int(host),
+        "e": int(epoch),
+        "v": int(version),
+        "scalars": scalars or {},
+    }
+    return pack_block(meta, [batch[k] for k in EXPERIENCE_KEYS])
+
+
+def unpack_experience(
+    frames: Sequence[Any],
+) -> Tuple[int, int, int, Dict[str, float], Dict[str, np.ndarray]]:
+    """Inverse of :func:`pack_experience`:
+    ``(host, epoch, version, scalars, batch)`` — arrays are zero-copy
+    views over the frames (they keep the frames alive,
+    serialize.unpack_block)."""
+    meta, arrays = unpack_block(frames)
+    if len(arrays) != len(EXPERIENCE_KEYS):
+        raise ValueError(
+            f"experience message carries {len(arrays)} arrays, expected "
+            f"{len(EXPERIENCE_KEYS)} ({EXPERIENCE_KEYS})"
+        )
+    batch = dict(zip(EXPERIENCE_KEYS, arrays))
+    return (
+        int(meta["host"]),
+        int(meta.get("e", 0)),
+        int(meta["v"]),
+        dict(meta["scalars"]),
+        batch,
+    )

@@ -73,22 +73,25 @@ def prometheus_text(snapshots: Optional[Dict[str, Dict[str, dict]]] = None) -> s
 
 
 def export_scalars(
-    roles=("master", "predictor", "learner", "fleet", "orchestrator"),
+    roles=("master", "predictor", "learner", "fleet", "orchestrator", "pod"),
     prefix: str = "tele/",
 ) -> Dict[str, float]:
     """Counters + gauges flattened to ``{"tele/<role>/<name>": value}`` for
     the stat.json/TB writers (histograms export their _count/_sum).
 
-    Each requested role matches itself AND its per-fleet variants
-    (``master`` also exports ``master.f0``/``master.f1``/... — the
-    telemetry.fleet_role scheme), so a multi-fleet run's stat.json grows
-    the per-fleet series without every caller enumerating fleets.
+    Each requested role matches itself AND its dotted sub-roles: the
+    per-fleet scheme (``master`` also exports ``master.f0``/``master.f1``
+    — telemetry.fleet_role) and the pod's per-host scheme (``pod``
+    exports ``pod.host0``/``pod.host1``/... — pod/wire.py pod_role, the
+    learner-side mirror of each actor host's progress), so multi-fleet
+    and pod runs grow their per-component series without every caller
+    enumerating fleets or hosts.
     """
     out: Dict[str, float] = {}
     regs = metrics.all_registries()
     for base in roles:
         for role in sorted(regs):
-            if role != base and not role.startswith(f"{base}.f"):
+            if role != base and not role.startswith(f"{base}."):
                 continue
             for name, v in regs[role].scalars().items():
                 out[f"{prefix}{role}/{name}"] = v

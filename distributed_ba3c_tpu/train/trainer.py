@@ -112,7 +112,10 @@ class Trainer:
             # in-flight forward reading a donated-and-reused buffer crashes in
             # native code. The copy is one small device-to-device transfer.
             params = jax.tree_util.tree_map(jnp.copy, self.state.params)
-            self.predictor.update_params(params)
+            # sanctioned single-host publish: the version IS the train
+            # step (publish_every cadence), and the pod plane replaces
+            # this path entirely when hosts serve from the stale cache
+            self.predictor.update_params(params)  # ba3clint: disable=A10
 
     def _drain_scores(self):
         if self.score_queue is None:

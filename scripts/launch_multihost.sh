@@ -1,112 +1,27 @@
 #!/usr/bin/env bash
-# Multi-host launcher: the reference's cluster launch surface (SURVEY.md §2.8
-# #29 — srun/ssh fan-out building --ps_hosts/--worker_hosts lists), minus the
-# ps tier (obsolete on TPU; gradients ride ICI/DCN collectives).
+# DEPRECATED SHIM — the multi-host launch loop lives in the Python
+# orchestrator now (orchestrate/multihost.py: rank derivation, the exit-75
+# relaunch loop, and the finalized-checkpoint resume gate shared with
+# `python -m distributed_ba3c_tpu.orchestrate` learner failover — counted
+# and flight-recorded there). This script only warns and delegates so
+# existing srun/ssh fan-out lines keep working:
 #
-# Usage:
 #   scripts/launch_multihost.sh "host1:9900,host2:9900" [train.py args...]
+#     ==  python -m distributed_ba3c_tpu.orchestrate \
+#             --multihost "host1:9900,host2:9900" -- [train.py args...]
 #
-# Runs this host's worker: rank = position of $(hostname) in the list.
-# Under Slurm, simply:  srun scripts/launch_multihost.sh "$WORKER_HOSTS" ...
-# (every task computes its own rank the same way; SLURM_PROCID overrides).
+# Under Slurm: srun scripts/launch_multihost.sh "$WORKER_HOSTS" ...
+# (SLURM_PROCID still overrides the hostname->rank lookup, as before).
 set -euo pipefail
 
 WORKER_HOSTS="${1:?usage: launch_multihost.sh host1:p,host2:p [args...]}"
 shift
 
-if [[ -n "${SLURM_PROCID:-}" ]]; then
-  TASK_INDEX="$SLURM_PROCID"
-else
-  HOSTNAME_SHORT=$(hostname -s)
-  TASK_INDEX=$(python3 - "$WORKER_HOSTS" "$HOSTNAME_SHORT" <<'EOF'
-import sys
-hosts = [h.split(":")[0].split(".")[0] for h in sys.argv[1].split(",")]
-print(hosts.index(sys.argv[2]))
-EOF
-)
-fi
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="$REPO_ROOT${PYTHONPATH:+:$PYTHONPATH}"
 
-echo "[launch] worker_hosts=$WORKER_HOSTS task_index=$TASK_INDEX"
-# Rank-failure semantics (parallel/watchdog.py): if a peer rank dies, every
-# survivor exits 75 within --rank_stall_timeout (default 600s). Exit 75 is
-# retry-able: loop a relaunch that RESUMES from the run's shared checkpoint
-# dir instead of stranding the allocation (README 'Rank-failure semantics').
-LOGDIR=""
-CALLER_LOADS=0
-prev=""
-for a in "$@"; do
-  case "$a" in
-    --logdir=*) LOGDIR="${a#--logdir=}" ;;
-    --load|--load=*) CALLER_LOADS=1 ;;
-  esac
-  if [[ "$prev" == "--logdir" ]]; then LOGDIR="$a"; fi
-  prev="$a"
-done
-relaunch=0
-while :; do
-  args=("$@")
-  # resume ONLY on relaunch after a lost-lockstep exit: the first launch
-  # keeps fresh-start semantics even over a reused logdir (a silent
-  # auto-resume there could "complete" a finished run with zero training).
-  # On relaunch the run's OWN checkpoints take precedence over a
-  # caller-supplied --load: the caller's path is a warm-START source, and
-  # reusing it verbatim would discard every checkpoint saved since launch
-  # (recurring rank failures would replay the same training span forever).
-  if [[ $relaunch -eq 1 ]]; then
-    # a FINALIZED saved checkpoint, not just the dir or a ckpt-* entry:
-    # CheckpointManager creates $LOGDIR/checkpoints at startup, and a rank
-    # killed mid-save leaves orbax temp dirs / finalized dirs whose
-    # checkpoint.json "latest" was never written — resuming from any of
-    # those crashes with exit 1 and permanently kills the retry loop (and
-    # discards a caller warm start). The meta's non-null "latest" is the
-    # only resumable signal (written strictly after wait_until_finished).
-    have_run_ckpt=0
-    if [[ -n "$LOGDIR" && -f "$LOGDIR/checkpoints/checkpoint.json" ]]; then
-      if python3 - "$LOGDIR/checkpoints/checkpoint.json" <<'EOF'
-import json, sys
-meta = json.load(open(sys.argv[1]))
-sys.exit(0 if meta.get("latest") is not None else 1)
-EOF
-      then
-        have_run_ckpt=1
-      fi
-    fi
-    if [[ $have_run_ckpt -eq 1 ]]; then
-      if [[ $CALLER_LOADS -eq 1 ]]; then
-        echo "[launch] resume: replacing caller --load with the run's own" \
-          "$LOGDIR/checkpoints (progress since launch lives there)" >&2
-        stripped=()
-        skip_next=0
-        for a in "${args[@]}"; do
-          if [[ $skip_next -eq 1 ]]; then skip_next=0; continue; fi
-          case "$a" in
-            --load) skip_next=1; continue ;;
-            --load=*) continue ;;
-          esac
-          stripped+=("$a")
-        done
-        args=("${stripped[@]}")
-      fi
-      args+=(--load "$LOGDIR/checkpoints")
-    elif [[ $CALLER_LOADS -eq 1 ]]; then
-      echo "[launch] exit 75, no run-local checkpoint saved yet — retrying" \
-        "with the caller's --load (warm start)" >&2
-    else
-      echo "[launch] exit 75 but no saved checkpoint to resume from" \
-        "(logdir='$LOGDIR') — relaunching fresh" >&2
-    fi
-  fi
-  set +e
-  python train.py \
-    --job_name worker \
-    --worker_hosts "$WORKER_HOSTS" \
-    --task_index "$TASK_INDEX" \
-    "${args[@]}"
-  rc=$?
-  set -e
-  if [[ $rc -ne 75 ]]; then
-    exit $rc
-  fi
-  relaunch=1
-  echo "[launch] rank lost lockstep (exit 75) — relaunching with resume" >&2
-done
+echo "[launch] launch_multihost.sh is a deprecated shim — use" \
+  "'python -m distributed_ba3c_tpu.orchestrate --multihost ...' directly" >&2
+
+exec python3 -m distributed_ba3c_tpu.orchestrate \
+  --multihost "$WORKER_HOSTS" -- "$@"

@@ -1,0 +1,29 @@
+"""A10 clean fixture: the idioms the repo actually uses (must stay quiet)."""
+
+
+def serve(predictor, states):
+    # reads go through the serving surface, never the policy table
+    actions, values, greedy = predictor.predict_batch(states)
+    return actions
+
+
+def snapshot(state):
+    # train-state params access is not a predictor policy-table read
+    return state.params
+
+
+class Cache:
+    """A non-predictor holder may keep a private _params of its own."""
+
+    def __init__(self):
+        self._params = None
+
+    def apply(self, params):
+        self._params = params
+        return self._params
+
+
+def tune(scheduler, params):
+    # an unrelated update_params API (non-predictor receiver) is not a
+    # params publish — the rule must not force a bogus suppression here
+    scheduler.update_params(params)

@@ -1,0 +1,20 @@
+"""A10 fixture: unversioned predictor params access (must be flagged)."""
+
+
+def publish_sideways(predictor, params):
+    # a stray publish outside the versioned plane: no version names these
+    # weights, the pod's params_lag stamp becomes a lie
+    predictor.update_params(params)
+
+
+def fan_out(predictors, params):
+    for pred in predictors:
+        pred.update_params(params, policy="default")
+
+
+def poke_policy_table(predictor):
+    # reading the predictor's policy table directly bypasses the same
+    # accounting on the read side
+    stale = predictor._params
+    predictor._policies["default"] = stale
+    return stale

@@ -272,6 +272,7 @@ def test_registry_names():
         "parallel.train_step",
         "parallel.vtrace_macro_step",
         "parallel.vtrace_step",
+        "pod.learner",
         "predict.server",
         "predict.server_greedy",
     ]

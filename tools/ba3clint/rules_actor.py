@@ -717,6 +717,87 @@ class ServingHotPathBlockRule(Rule):
         return True
 
 
+#: predictor policy-table internals whose direct access bypasses the
+#: versioned publish path
+_PARAMS_ATTRS = {"_params", "_policies"}
+_PREDICTORISH_FRAGMENTS = ("pred", "serving")
+
+
+def _predictorish(expr: ast.AST) -> bool:
+    for sub in ast.walk(expr):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name and any(f in name.lower() for f in _PREDICTORISH_FRAGMENTS):
+            return True
+    return False
+
+
+class UnversionedParamsReadRule(Rule):
+    """A10: direct ``update_params``/params-table access on a predictor
+    outside the versioned params plane (``pod/``, ``predict/``).
+
+    The pod's staleness accounting (docs/pod.md) rests on ONE invariant:
+    every parameter publish into a serving predictor goes through a
+    versioned path — the learner's counted publish or the actor-host
+    :class:`StaleParamsCache` — so each experience block's version stamp
+    actually names the policy that produced it. A stray
+    ``predictor.update_params(...)`` (or a poke at the ``_params``/
+    ``_policies`` policy table) silently serves weights NO version names:
+    the learner's measured ``params_lag`` becomes a lie and the
+    ``--max_staleness`` bound guards the wrong quantity. The sanctioned
+    call sites — the Trainer's synchronous single-host publish (its
+    version IS the train step) and the FanoutPredictors fan-out facade —
+    carry suppressions stating exactly that; everything else routes
+    through the cache (pod/cache.py ``on_update``). ``predict/`` itself
+    is exempt (the predictor owns its table), as is ``pod/`` (the plane
+    being protected).
+    """
+
+    id = "A10"
+    name = "unversioned-params-read"
+    summary = "predictor params published/read outside the versioned pod params plane"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        parts = ctx.path.replace(os.sep, "/").split("/")
+        if "pod" in parts or "predict" in parts:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr == "update_params"
+                    # predictor-ish receivers only (same filter as the
+                    # attribute branch): an unrelated object with an
+                    # update_params method must not force a bogus
+                    # suppression that dilutes the audit trail
+                    and _predictorish(fn.value)
+                ):
+                    yield ctx.finding(
+                        self, node,
+                        ".update_params() outside the versioned params "
+                        "plane — publish through the pod cache "
+                        "(pod/cache.py on_update) or a sanctioned "
+                        "learner-publish site with a suppression naming "
+                        "its version source (docs/pod.md)",
+                    )
+            elif isinstance(node, ast.Attribute):
+                if (
+                    node.attr in _PARAMS_ATTRS
+                    and _predictorish(node.value)
+                ):
+                    yield ctx.finding(
+                        self, node,
+                        f"direct .{node.attr} access on a predictor — the "
+                        "policy table is the predictor's own; readers go "
+                        "through predict_batch/update_params so the "
+                        "version accounting holds (docs/pod.md)",
+                    )
+
+
 ACTOR_RULES = [
     BareThreadRule(),
     BlockingQueueOpRule(),
@@ -727,4 +808,5 @@ ACTOR_RULES = [
     AdhocMetricRule(),
     UnsupervisedFleetSpawnRule(),
     ServingHotPathBlockRule(),
+    UnversionedParamsReadRule(),
 ]
