@@ -427,6 +427,7 @@ def bench_zmq_plane(
     null_device: bool = False, wire: str = "per-env",
     envs_per_proc: int = 32, warmup_datapoints: int = 512,
     windows: int = 1, telemetry_on: bool = True, fleets: int = 1,
+    trace_sample: int = 0,
 ) -> dict:
     """Actor-plane throughput (BASELINE configs #1/#2): C++ batched env
     servers -> ZMQ -> master -> batched TPU predictor, counting n-step
@@ -472,6 +473,12 @@ def bench_zmq_plane(
     telemetry.reset_all()
     telemetry.set_enabled(telemetry_on)
     os.environ["BA3C_TELEMETRY"] = "1" if telemetry_on else "0"
+    # the trace plane's A/B lever rides the same pattern (plane_bench
+    # --trace both): sampling armed here for the master/predictor side,
+    # via the env var for the spawned env servers
+    trace_n = trace_sample if telemetry_on else 0
+    telemetry.tracing.set_sampling(trace_n)
+    os.environ["BA3C_TRACE"] = str(trace_n)
 
     n_actions = native.CppBatchedEnv(game, 1).num_actions
     cfg = BA3CConfig(num_actions=n_actions, predict_batch_size=256)

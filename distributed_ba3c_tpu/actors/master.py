@@ -65,23 +65,42 @@ class BA3CSimulatorMaster(SimulatorMaster):
         self.score_queue = score_queue
 
     def _on_state(self, state: np.ndarray, ident: bytes) -> None:
+        # claim the receive loop's parked trace ref (tracing.py sampling)
+        client0 = self.clients[ident]
+        ref, client0.pending_trace = client0.pending_trace, None
+        if ref is not None:
+            # receive -> dispatch: decode + the PREVIOUS step's flush,
+            # including any train-queue backpressure stall — attributed
+            # to the master here so it never lands inside the predict
+            # spans (the plane exists to point at the right stage)
+            ref = ref.hop("master_ingest", self.tele_role)
+
         def cb(action: int, value: float, logp: float):
             client = self.clients[ident]
             # safe cross-thread append: the simulator is blocked awaiting
             # this very action, so the master cannot touch client.memory
             # until send_action below releases it (protocol serialization;
             # the BA3C_SANITIZE=1 job watches the table half of this claim)
+            trace = ref.hop("predict", self.tele_role) if ref else None
             client.memory.append(  # ba3clint: disable=A3
-                TransitionExperience(state, action, value)
+                TransitionExperience(state, action, value, trace=trace)
             )
             self.send_action(ident, action)
 
         # shed fallback (docs/serving.md): under an SLO'd predictor a shed
         # task answers with a uniform-random action instead of wedging the
-        # simulator; without deadlines (the default) it never fires
-        self.predictor.put_task(
-            state, cb, shed_callback=self._shed_fallback_row(cb)
-        )
+        # simulator; without deadlines (the default) it never fires.
+        # trace= only when sampled (the duck-typed-predictor contract the
+        # block path documents)
+        if ref is None:
+            self.predictor.put_task(
+                state, cb, shed_callback=self._shed_fallback_row(cb)
+            )
+        else:
+            self.predictor.put_task(
+                state, cb, shed_callback=self._shed_fallback_row(cb),
+                trace=ref,
+            )
 
     def _on_episode_over(self, ident: bytes) -> None:
         client = self.clients[ident]
@@ -105,13 +124,23 @@ class BA3CSimulatorMaster(SimulatorMaster):
         if not is_over:
             last = mem[-1]
             mem = mem[:-1]
+        # a sampled step's trace continues on the FIRST datapoint this
+        # flush emits (the per-env mirror of _flush_cohort's claim); the
+        # rider is stripped by the feed before collate (data/dataflow.py)
+        rider = None
+        for k in mem:
+            if k.trace is not None:
+                rider, k.trace = k.trace.hop("nstep_flush", self.tele_role), None
+                break
         R = float(init_r)
         for k in reversed(mem):
             R = k.reward + self.gamma * R
+            item = [k.state, k.action, np.float32(R)]
+            if rider is not None:
+                item.append(rider)
+                rider = None
             # backpressure pauses actors, but must stay shutdown-responsive
-            if not self._put_stoppable(
-                self.queue, [k.state, k.action, np.float32(R)]
-            ):
+            if not self._put_stoppable(self.queue, item):
                 return  # master stopped while the learner was backed up
         self._c_datapoints.inc(len(mem))  # one batched inc per flush
         client.memory = [] if is_over else [last]
@@ -119,6 +148,14 @@ class BA3CSimulatorMaster(SimulatorMaster):
     # -- block wire (one message per env-server per step) ------------------
     def _on_block_state(self, states: np.ndarray, ident: bytes) -> None:
         blk = self.clients[ident]
+        # claim the receive loop's parked trace ref (None for the
+        # untraced (N-1)/N of steps — tracing.py sampling)
+        ref, blk.pending_trace = blk.pending_trace, None
+        if ref is not None:
+            # receive -> dispatch: decode + the previous step's flush
+            # (incl. backpressure stalls) stays a MASTER hop — see
+            # _on_state
+            ref = ref.hop("master_ingest", self.tele_role)
 
         def cb(actions: np.ndarray, values: np.ndarray, logps: np.ndarray):
             # safe cross-thread append: the env server is blocked awaiting
@@ -127,17 +164,29 @@ class BA3CSimulatorMaster(SimulatorMaster):
             # serialization, same argument as the per-env callback; blk is
             # captured by object so a pruned block is never resurrected
             # through the defaultdict from this thread)
-            blk.steps.append(  # ba3clint: disable=A3 — protocol-serialized, see above
-                BlockStep(states, actions, values, logps)
-            )
+            st = BlockStep(states, actions, values, logps)
+            if ref is not None:
+                # the serve RTT span (recv -> actions in hand); the
+                # predictor's dispatch/fetch sub-spans ride the same trace
+                st.trace = ref.hop("predict", self.tele_role)
+            blk.steps.append(st)  # ba3clint: disable=A3 — protocol-serialized, see above
             self.send_block_actions(ident, actions)
 
         # same fallback contract as the per-env path: a shed block gets
-        # uniform-random actions so the lockstep server never wedges
-        self.predictor.put_block_task(
-            states, cb,
-            shed_callback=self._shed_fallback_block(cb, len(states)),
-        )
+        # uniform-random actions so the lockstep server never wedges.
+        # trace= only when sampled: the common path keeps the exact
+        # pre-tracing call (and duck-typed predictors need no new kwarg)
+        if ref is None:
+            self.predictor.put_block_task(
+                states, cb,
+                shed_callback=self._shed_fallback_block(cb, len(states)),
+            )
+        else:
+            self.predictor.put_block_task(
+                states, cb,
+                shed_callback=self._shed_fallback_block(cb, len(states)),
+                trace=ref,
+            )
 
     def _on_block_flush(self, ident: bytes) -> None:
         """Per-env n-step emission over the block's shared step list.
@@ -203,8 +252,20 @@ class BA3CSimulatorMaster(SimulatorMaster):
             R32 = R.astype(np.float32)
             states = st.states
             acts = st.actions[cohort].tolist()
+            # a sampled step's trace continues on the FIRST datapoint its
+            # flush emits (one block lifetime = one trace, claimed once —
+            # the other B-1 envs share the step but not the trace); the
+            # 4th element rides the [state, action, R] item and is
+            # stripped by the feed before collate (data/dataflow.py)
+            ref, st.trace = st.trace, None
+            if ref is not None:
+                ref = ref.hop("nstep_flush", self.tele_role)
             for i, j in enumerate(js):
-                if not put(q, [states[j], acts[i], R32[i]]):
+                item = [states[j], acts[i], R32[i]]
+                if ref is not None:
+                    item.append(ref)
+                    ref = None
+                if not put(q, item):
                     return False
         # telemetry, batched per cohort (not per datapoint — hot-path
         # budget): datapoint count plus the e2e env-step -> train-ingest

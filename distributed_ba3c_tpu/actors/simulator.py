@@ -30,6 +30,7 @@ import numpy as np
 import zmq
 
 from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.telemetry import tracing
 from distributed_ba3c_tpu.envs.base import RLEnvironment
 from distributed_ba3c_tpu.utils import logger, sanitizer
 from distributed_ba3c_tpu.utils.concurrency import (
@@ -42,24 +43,28 @@ from distributed_ba3c_tpu.utils.serialize import dumps, loads, unpack_block
 class TransitionExperience:
     """One (state, action, value) awaiting its reward attachment."""
 
-    __slots__ = ("state", "action", "reward", "value")
+    __slots__ = ("state", "action", "reward", "value", "trace")
 
-    def __init__(self, state, action, value, reward=None):
+    def __init__(self, state, action, value, reward=None, trace=None):
         self.state = state
         self.action = action
         self.value = value
         self.reward = reward
+        self.trace = trace  # tracing.TraceRef when this step was sampled
 
 
 class ClientState:
     """Per-simulator state held by the master, keyed by ZMQ ident."""
 
-    __slots__ = ("memory", "ident", "score", "last_seen")
+    __slots__ = ("memory", "ident", "score", "last_seen", "pending_trace")
 
     def __init__(self, ident: bytes):
         self.ident = ident
         self.memory: List[TransitionExperience] = []
         self.score = 0.0
+        # sampled trace ref parked between receive and the predictor
+        # callback (protocol-serialized, see BlockClientState)
+        self.pending_trace = None
         # initialized to creation time so a client that NEVER sends again
         # (e.g. resurrected by a late predictor callback after pruning) still
         # ages out instead of being exempt forever. MONOTONIC, not wall
@@ -75,6 +80,7 @@ class BlockStep:
 
     __slots__ = (
         "states", "actions", "values", "logps", "rewards", "dones", "recv_t",
+        "trace",
     )
 
     def __init__(self, states, actions, values, logps):
@@ -89,6 +95,10 @@ class BlockStep:
         # 0.0 when disabled so the overhead gate's off arm runs the true
         # pre-telemetry hot path (flush sites skip the observe on falsy)
         self.recv_t = time.monotonic() if telemetry.enabled() else 0.0
+        # tracing.TraceRef when this step was 1-in-N sampled (None for the
+        # untraced (N-1)/N — the flush sites branch on None, never on the
+        # sampling math)
+        self.trace = None
 
 
 class BlockStatesView:
@@ -153,7 +163,7 @@ class BlockClientState:
 
     __slots__ = (
         "ident", "n_envs", "scores", "steps", "start", "last_seen",
-        "ring", "ages", "last_step",
+        "ring", "ages", "last_step", "pending_trace",
     )
 
     def __init__(self, ident: bytes, n_envs: int):
@@ -168,6 +178,12 @@ class BlockClientState:
         # newest wire step seen; a step that goes BACKWARDS means the server
         # restarted under this ident (master resets the incarnation)
         self.last_step = -1
+        # the current message's decoded trace ref, parked here between the
+        # receive loop and the predictor callback that creates its
+        # BlockStep (safe: the lockstep protocol admits no second message
+        # from this ident until that callback ran — the same argument the
+        # A3 suppressions on the callbacks make)
+        self.pending_trace = None
 
     def close(self) -> None:
         if self.ring is not None:
@@ -235,18 +251,22 @@ class SimulatorProcess(_spawn_ctx.Process):  # type: ignore[name-defined]
         state = player.current_state()
         reward, is_over = 0.0, False
         step = 0
+        env_us = 0  # last env-step duration, shipped in the trace context
         try:
             while True:
                 msg = [ident, state, reward, is_over]
+                d = None
                 if (
                     telemetry.enabled()
                     and step and step % telemetry.PIGGYBACK_EVERY == 0
                 ):
-                    d = tracker.deltas()
-                    if d:
-                        msg.append(d)  # length-versioned 5th element
+                    d = tracker.deltas() or None
+                # length-versioned tail: deltas 5th element, sampled trace
+                # context 6th (THE one layout implementation — tracing.py)
+                tracing.stamp_wire_meta(msg, ident, step, d, env_us)
                 c2s.send(dumps(msg))
                 action = loads(s2c.recv())
+                t_env = tracing.now_us() if tracing.enabled() else 0
                 reward, is_over = player.action(action)
                 c_steps.inc()
                 if is_over:
@@ -257,6 +277,8 @@ class SimulatorProcess(_spawn_ctx.Process):  # type: ignore[name-defined]
                 elif reward < 0:
                     c_rew_neg.inc(-reward)
                 state = player.current_state()
+                if t_env:
+                    env_us = tracing.now_us() - t_env
                 step += 1
         except (KeyboardInterrupt, zmq.ContextTerminated):
             pass
@@ -466,6 +488,12 @@ class SimulatorMaster(threading.Thread):
                     client = self.clients[ident]
                     client.ident = ident
                     client.last_seen = time.monotonic()
+                    if len(msg) > 5:
+                        # element 6 is a sampled trace context (tracing.py):
+                        # handshake the sender's clock, synthesize the
+                        # env_step + wire spans, park the ref for the
+                        # predictor callback's transition record
+                        client.pending_trace = self._recv_trace(ident, msg[5])
                     self._on_message(ident, state, reward, is_over)
                 else:
                     self._on_block_frames(frames)
@@ -611,12 +639,19 @@ class SimulatorMaster(threading.Thread):
                     f"do not match header n_envs={n_envs}"
                 )
             if len(meta) > base_meta_len:
-                # length-versioned header: the last element is the server's
+                # length-versioned header: element base+1 is the server's
                 # piggybacked metric deltas (telemetry/wire.py); old
-                # base-length headers parse exactly as before
+                # base-length headers parse exactly as before. A sampled
+                # step appends a SECOND element — the trace context
+                # (tracing.py) — after a (possibly empty) deltas dict, so
+                # positions never shift under either feature alone.
                 telemetry.apply_fleet_deltas(
                     ident, meta[base_meta_len], role=self._fleet_tele_role
                 )
+            trace_elem = (
+                meta[base_meta_len + 1]
+                if len(meta) > base_meta_len + 1 else None
+            )
         except (ValueError, TypeError, IndexError) as e:
             # wire input is untrusted: a version-mismatched fleet (or any
             # stray sender on the bound port) must not kill the receive
@@ -652,6 +687,8 @@ class SimulatorMaster(threading.Thread):
             self.clients[ident] = blk
         blk.last_seen = time.monotonic()
         blk.last_step = step
+        if trace_elem is not None:
+            blk.pending_trace = self._recv_trace(ident, trace_elem)
         dones = dones.astype(bool)
         try:
             if obs is not None:
@@ -756,6 +793,17 @@ class SimulatorMaster(threading.Thread):
         ``ident`` can arrive before ``_on_block_state``'s callback ran.
         """
         blk = self.clients[ident]
+        if blk.pending_trace is not None:
+            # flight events recorded while this sampled block is being
+            # flushed/dispatched (queue_wait stalls, prunes) get stamped
+            # with its trace id — postmortem dumps correlate with /trace
+            # (telemetry/recorder.py); two thread-local ops, sampled only
+            with tracing.trace_scope(blk.pending_trace.trace_id):
+                self._dispatch_block(blk, states, rewards, dones, ident)
+        else:
+            self._dispatch_block(blk, states, rewards, dones, ident)
+
+    def _dispatch_block(self, blk, states, rewards, dones, ident) -> None:
         if blk.steps:
             last = blk.steps[-1]
             last.rewards = self._learn_reward_block(rewards)
@@ -838,6 +886,23 @@ class SimulatorMaster(threading.Thread):
             cb(a, 0.0, float(-np.log(A)))
 
         return shed
+
+    def _recv_trace(self, ident: bytes, trace_elem):
+        """Decode one received trace-context element (tracing.py).
+
+        Handshakes the sender's monotonic clock, synthesizes the sender's
+        ``env_step`` span (duration shipped in the context — env servers
+        never expose a scrape endpoint) plus the ``wire`` transit span,
+        and returns a TraceRef for this master's own hops — or None on
+        junk (wire input is untrusted, the block decoder's posture)."""
+        out = tracing.receive_context(
+            tracing.decode_context(trace_elem),
+            peer=repr(ident), role=self.tele_role, origin_always=True,
+        )
+        if out is None:
+            return None
+        trace_id, parent = out
+        return tracing.TraceRef(trace_id, parent)
 
     def send_action(self, ident: bytes, action: int) -> None:
         self._put_stoppable(self.send_queue, [ident, dumps(int(action))])

@@ -25,15 +25,17 @@ from distributed_ba3c_tpu.actors.simulator import (
     BlockStep,
     SimulatorMaster,
 )
+from distributed_ba3c_tpu.telemetry import tracing
 from distributed_ba3c_tpu.predict.server import BatchedPredictor
 from distributed_ba3c_tpu.utils import sanitizer
 from distributed_ba3c_tpu.utils.concurrency import FastQueue
 
 
 class _Step:
-    __slots__ = ("state", "action", "logp", "value", "reward", "done")
+    __slots__ = ("state", "action", "logp", "value", "reward", "done",
+                 "trace")
 
-    def __init__(self, state, action, logp, value=0.0):
+    def __init__(self, state, action, logp, value=0.0, trace=None):
         self.state = state
         self.action = action
         self.logp = logp
@@ -43,6 +45,7 @@ class _Step:
         self.value = value
         self.reward = 0.0
         self.done = False
+        self.trace = trace  # tracing.TraceRef when this step was sampled
 
 
 class VTraceSimulatorMaster(SimulatorMaster):
@@ -98,20 +101,38 @@ class VTraceSimulatorMaster(SimulatorMaster):
         self.score_queue = score_queue
 
     def _on_state(self, state: np.ndarray, ident: bytes) -> None:
+        # claim the receive loop's parked trace ref (tracing.py sampling)
+        client0 = self.clients[ident]
+        ref, client0.pending_trace = client0.pending_trace, None
+        if ref is not None:
+            # receive -> dispatch: decode + previous-step flush (incl.
+            # backpressure stalls) stays a MASTER hop, never inside the
+            # predict spans (BA3CSimulatorMaster._on_state documents why)
+            ref = ref.hop("master_ingest", self.tele_role)
+
         def cb(action: int, value: float, logp: float):
             client = self.clients[ident]
             # safe cross-thread append: the simulator is blocked awaiting
             # this very action, so the master cannot reslice client.memory
             # until send_action below releases it (protocol serialization;
             # the BA3C_SANITIZE=1 job watches the table half of this claim)
-            client.memory.append(_Step(state, action, logp, value))  # ba3clint: disable=A3
+            trace = ref.hop("predict", self.tele_role) if ref else None
+            client.memory.append(_Step(state, action, logp, value, trace))  # ba3clint: disable=A3
             self.send_action(ident, action)
 
         # shed fallback (docs/serving.md): the uniform logp the fallback
-        # records is the TRUE behavior policy, so V-trace stays exact
-        self.predictor.put_task(
-            state, cb, shed_callback=self._shed_fallback_row(cb)
-        )
+        # records is the TRUE behavior policy, so V-trace stays exact.
+        # trace= only when sampled: the common path keeps the exact
+        # pre-tracing call (and duck-typed predictors need no new kwarg)
+        if ref is None:
+            self.predictor.put_task(
+                state, cb, shed_callback=self._shed_fallback_row(cb)
+            )
+        else:
+            self.predictor.put_task(
+                state, cb, shed_callback=self._shed_fallback_row(cb),
+                trace=ref,
+            )
 
     def _on_datapoint(self, ident: bytes) -> None:
         pass  # segment emission happens in _on_message
@@ -168,6 +189,13 @@ class VTraceSimulatorMaster(SimulatorMaster):
             segment["behavior_values"] = np.asarray(
                 [s.value for s in seg], np.float32
             )
+        # a sampled step inside this unroll hands its trace to the segment
+        # (claimed once; stripped by the feed before collate)
+        for s in seg:
+            if s.trace is not None:
+                segment["_trace"] = s.trace.hop("unroll_flush", self.tele_role)
+                s.trace = None
+                break
         client.memory = rest
         # backpressure pauses actors, but must stay shutdown-responsive
         self._put_stoppable(self.queue, segment)
@@ -176,21 +204,37 @@ class VTraceSimulatorMaster(SimulatorMaster):
     # -- block wire (one message per env-server per step) ------------------
     def _on_block_state(self, states: np.ndarray, ident: bytes) -> None:
         blk = self.clients[ident]
+        # claim the receive loop's parked trace ref (tracing.py sampling)
+        ref, blk.pending_trace = blk.pending_trace, None
+        if ref is not None:
+            # receive -> dispatch stays a MASTER hop, never inside the
+            # predict spans (BA3CSimulatorMaster._on_state documents why)
+            ref = ref.hop("master_ingest", self.tele_role)
 
         def cb(actions: np.ndarray, values: np.ndarray, logps: np.ndarray):
             # safe cross-thread append: the env server is blocked awaiting
             # this very action block, so the master cannot reslice blk.steps
             # until send_block_actions below releases it (protocol
             # serialization, same argument as the per-env callback)
-            blk.steps.append(  # ba3clint: disable=A3 — protocol-serialized, see above
-                BlockStep(states, actions, values, logps)
-            )
+            st = BlockStep(states, actions, values, logps)
+            if ref is not None:
+                # serve RTT (recv -> actions); the predictor's own
+                # dispatch/fetch sub-spans ride the same trace
+                st.trace = ref.hop("predict", self.tele_role)
+            blk.steps.append(st)  # ba3clint: disable=A3 — protocol-serialized, see above
             self.send_block_actions(ident, actions)
 
-        self.predictor.put_block_task(
-            states, cb,
-            shed_callback=self._shed_fallback_block(cb, len(states)),
-        )
+        if ref is None:
+            self.predictor.put_block_task(
+                states, cb,
+                shed_callback=self._shed_fallback_block(cb, len(states)),
+            )
+        else:
+            self.predictor.put_block_task(
+                states, cb,
+                shed_callback=self._shed_fallback_block(cb, len(states)),
+                trace=ref,
+            )
 
     def _on_block_flush(self, ident: bytes) -> None:
         """Per-env unroll emission (block analogue of :meth:`_maybe_emit`).
@@ -203,6 +247,10 @@ class VTraceSimulatorMaster(SimulatorMaster):
         blk: BlockClientState = self.clients[ident]
         T = self.unroll_len
         t_end = len(blk.steps)
+        # hoisted trace arm check: the per-segment trace scan runs only
+        # when sampling is live, so the tracing-off hot path pays ONE call
+        # per flush tick (the --trace both gate's off arm)
+        trace_on = tracing.enabled()
         for j in range(blk.n_envs):
             while t_end - blk.start[j] >= T + 1:
                 s = int(blk.start[j])
@@ -230,6 +278,18 @@ class VTraceSimulatorMaster(SimulatorMaster):
                     segment["behavior_values"] = np.asarray(
                         [st.values[j] for st in seg], np.float32
                     )
+                if trace_on:
+                    # a sampled step's trace continues on the FIRST
+                    # segment that flushes it (one block lifetime = one
+                    # trace; the other B-1 envs share the step object,
+                    # claimed once)
+                    for st in seg:
+                        if st.trace is not None:
+                            segment["_trace"] = st.trace.hop(
+                                "unroll_flush", self.tele_role
+                            )
+                            st.trace = None
+                            break
                 blk.start[j] = s + T
                 self._put_stoppable(self.queue, segment)
                 # batched telemetry per emitted segment (T datapoints, one

@@ -164,7 +164,8 @@ def make_parser() -> argparse.ArgumentParser:
         "observability (tele/predictor/shadow_* series)",
     )
     p.add_argument("--profiler_port", type=int, default=0, help="start jax.profiler server on this port (0=off)")
-    p.add_argument("--telemetry_port", type=int, default=0, help="serve the telemetry scrape endpoint on this port (0=off): /metrics Prometheus text, /json raw snapshots, /flight the live flight-recorder ring (docs/observability.md)")
+    p.add_argument("--telemetry_port", type=int, default=0, help="serve the telemetry scrape endpoint on this port (0=off): /metrics Prometheus text, /json raw snapshots, /flight the live flight-recorder ring, /trace the span buffer (docs/observability.md)")
+    p.add_argument("--trace_sample", type=int, default=0, help="trace 1 in N block steps through the distributed trace plane (0=off): sampled causal spans env-step->learner-step with per-hop hop_<name>_s histograms, scraped at /trace and rendered by scripts/trace_dump.py (docs/observability.md)")
     p.add_argument("--pipe_c2s", default=None, help="master experience-plane bind address, e.g. tcp://0.0.0.0:5555 (default: per-pid ipc://)")
     p.add_argument("--pipe_s2c", default=None, help="master action-plane bind address, e.g. tcp://0.0.0.0:5556 (default: per-pid ipc://)")
     p.add_argument("--max_to_keep", type=int, default=3, help="checkpoints retained (besides best); raise to keep every eval-epoch checkpoint for post-hoc crossing verification")
@@ -448,6 +449,12 @@ def main(argv: Optional[list] = None) -> int:
         # spawned children (env servers, simulators) read this at import —
         # without it their postmortem dumps land in /tmp, not the logdir
         os.environ["BA3C_FLIGHT_DIR"] = args.logdir
+    if args.trace_sample > 0:
+        # arm the trace plane here AND in the env var: spawned env-server
+        # children read BA3C_TRACE at import, exactly the BA3C_TELEMETRY
+        # inheritance idiom (telemetry/tracing.py)
+        telemetry.tracing.set_sampling(args.trace_sample)
+        os.environ["BA3C_TRACE"] = str(args.trace_sample)
     if args.task == "train":
         telemetry.install_signal_dump()
 

@@ -18,7 +18,22 @@ from typing import Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
+from distributed_ba3c_tpu.telemetry.tracing import TraceRef
 from distributed_ba3c_tpu.utils.concurrency import StoppableThread
+
+
+def claim_trace(item):
+    """Strip a sampled trace rider off one feed item (tracing.py).
+
+    Masters hand the trace forward as a ``"_trace"`` key on segment dicts
+    (V-trace) or a trailing :class:`TraceRef` on ``[state, action, R]``
+    datapoint lists (BA3C) — either way it must come OFF before collate
+    stacks the item. Returns the ref or None; the item is mutated."""
+    if isinstance(item, dict):
+        return item.pop("_trace", None)
+    if isinstance(item, list) and item and isinstance(item[-1], TraceRef):
+        return item.pop()
+    return None
 
 
 class DataFlow(ABC):
@@ -115,15 +130,25 @@ class _BatchFeed:
         t = threading.current_thread()
         assert isinstance(t, StoppableThread)
         holder: List = []
+        trace = None  # sampled trace riding the batch being assembled
         while not t.stopped():
             item = t.queue_get_stoppable(self.in_queue, timeout=0.2)
             if item is None:
                 return  # stopped while the actor plane was quiet
+            ref = claim_trace(item)
+            if ref is not None:
+                # emit -> drain is the train-queue wait; one trace per
+                # batch (a second sampled item in the same holder is
+                # stripped but not double-attributed)
+                trace = trace or ref.hop("queue_wait", "learner")
             holder.append(item)
             if len(holder) < self.batch_size:
                 continue
             batch = self._collate(holder)
             holder = []
+            if trace is not None:
+                batch["_trace"] = trace.hop("collate", "learner")
+                trace = None
             if not t.queue_put_stoppable(self._out, batch, timeout=0.2):
                 return  # stopped while the learner was backed up
 
@@ -260,6 +285,7 @@ class FleetMergeFeed:
         K, B = len(self.queues), self.batch_size
         holders: List[list] = [[] for _ in range(K)]
         flat: list = []
+        trace = None  # sampled trace riding the macro-batch being banked
         rr = 0  # flat mode: fleet owed the next slot (round-robin cursor)
         while not t.stopped():
             drew = False
@@ -272,14 +298,21 @@ class FleetMergeFeed:
                 except queue.Empty:
                     continue
                 drew = True
+                ref = claim_trace(item)
+                if ref is not None:
+                    trace = trace or ref.hop("queue_wait", "learner")
                 if self.stacked:
                     holders[k].append(item)
                 else:
                     flat.append(item)
                     rr = (k + 1) % K  # next pass starts past the last draw
                     if len(flat) == B:
+                        out = self._collate_one(flat)
+                        if trace is not None:
+                            out["_trace"] = trace.hop("collate", "learner")
+                            trace = None
                         if not t.queue_put_stoppable(
-                            self._out, self._collate_one(flat), timeout=0.2
+                            self._out, out, timeout=0.2
                         ):
                             return
                         flat = []
@@ -290,6 +323,9 @@ class FleetMergeFeed:
                     for key in subs[0]
                 }
                 holders = [[] for _ in range(K)]
+                if trace is not None:
+                    batch["_trace"] = trace.hop("collate", "learner")
+                    trace = None
                 if not t.queue_put_stoppable(self._out, batch, timeout=0.2):
                     return
             if not drew:

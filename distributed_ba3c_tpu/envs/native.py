@@ -250,12 +250,17 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
     def _tele_setup(self):
         """Child-side telemetry: counters + the piggyback delta tracker.
 
-        Returns ``(count_step, piggyback)``: ``count_step(rew, dn)`` is
-        called once per lockstep block step; ``piggyback(step)`` returns
-        the deltas dict to append to the wire header (or None — which
-        keeps the header at its OLD length, so telemetry-disabled fleets
-        exercise the pre-telemetry wire format end-to-end)."""
+        Returns ``(count_step, piggyback, extend_meta)``: ``count_step``
+        is called once per lockstep block step; ``piggyback(step)``
+        returns the deltas dict to append to the wire header (or None —
+        which keeps the header at its OLD length, so telemetry-disabled
+        fleets exercise the pre-telemetry wire format end-to-end);
+        ``extend_meta(meta, step, env_us)`` appends the length-versioned
+        tail — the deltas element and, on 1-in-N sampled steps, the trace
+        context (telemetry/tracing.py) carrying this server's monotonic
+        stamp (clock handshake) and its last env-step duration."""
         from distributed_ba3c_tpu import telemetry
+        from distributed_ba3c_tpu.telemetry import tracing
 
         tele = telemetry.registry("simulator")
         c_steps = tele.counter("env_steps_total")
@@ -288,7 +293,16 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
                 return None
             return tracker.deltas() or None
 
-        return count_step, piggyback
+        ident = f"{self.ident_prefix}*block".encode()
+
+        def extend_meta(meta: list, step: int, env_us: int) -> None:
+            # THE one layout implementation lives in tracing.py — the
+            # python simulator sender calls the same helper
+            tracing.stamp_wire_meta(
+                meta, ident, step, piggyback(step), env_us
+            )
+
+        return count_step, piggyback, extend_meta
 
     def _run_block_shm(self) -> None:
         import signal
@@ -333,8 +347,11 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
         dealer.setsockopt(zmq.IDENTITY, ident)
         dealer.connect(self.s2c)
 
-        count_step, piggyback = self._tele_setup()
+        count_step, piggyback, extend_meta = self._tele_setup()
+        from distributed_ba3c_tpu.telemetry import tracing
+
         step = 0
+        env_us = 0  # last env.step duration, shipped in the trace context
         try:
             while True:
                 # the step's obs plane goes into the ring; the wire carries
@@ -342,15 +359,16 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
                 # frame-history windows from ring slots — docs/actor_plane.md)
                 ring.arr[step % cap] = obs
                 meta = [ident, step, B, ring_name, cap, H, W, hist]
-                tele = piggyback(step)
-                if tele is not None:
-                    meta.append(tele)  # length-versioned (telemetry/wire.py)
+                extend_meta(meta, step, env_us)  # length-versioned tail
                 push.send_multipart(
                     pack_block(meta, [rewards, dones]),
                     copy=False,
                 )
                 actions = np.frombuffer(dealer.recv(), np.int32)
+                t_env = tracing.now_us() if tracing.enabled() else 0
                 obs, rew, dn = env.step(actions)
+                if t_env:
+                    env_us = tracing.now_us() - t_env
                 rewards[:] = rew
                 dones[:] = dn
                 count_step(rew, dn)
@@ -387,14 +405,15 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
         dealer.setsockopt(zmq.IDENTITY, ident)
         dealer.connect(self.s2c)
 
-        count_step, piggyback = self._tele_setup()
+        count_step, piggyback, extend_meta = self._tele_setup()
+        from distributed_ba3c_tpu.telemetry import tracing
+
         step = 0
+        env_us = 0  # last env.step duration, shipped in the trace context
         try:
             while True:
                 meta = [ident, step, B]
-                tele = piggyback(step)
-                if tele is not None:
-                    meta.append(tele)  # length-versioned (telemetry/wire.py)
+                extend_meta(meta, step, env_us)  # length-versioned tail
                 # copy=False hands zmq the arrays' own buffers. Safe ONLY
                 # because the protocol is lockstep: the master cannot reply
                 # with actions before it has received (= fully copied out of
@@ -405,7 +424,10 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
                     copy=False,
                 )
                 actions = np.frombuffer(dealer.recv(), np.int32)
+                t_env = tracing.now_us() if tracing.enabled() else 0
                 obs, rew, dn = env.step(actions)
+                if t_env:
+                    env_us = tracing.now_us() - t_env
                 rewards[:] = rew
                 dones[:] = dn
                 count_step(rew, dn)
@@ -449,7 +471,7 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
             s.connect(self.s2c)
             dealers.append(s)
 
-        count_step, piggyback = self._tele_setup()
+        count_step, piggyback, _ = self._tele_setup()
         actions = np.zeros(B, np.int32)
         step = 0
         try:

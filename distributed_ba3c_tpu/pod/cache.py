@@ -32,7 +32,12 @@ import numpy as np
 import zmq
 
 from distributed_ba3c_tpu import telemetry
-from distributed_ba3c_tpu.pod.wire import PodEndpoints, pod_role, unpack_params
+from distributed_ba3c_tpu.telemetry import tracing
+from distributed_ba3c_tpu.pod.wire import (
+    PodEndpoints,
+    pod_role,
+    unpack_params_full,
+)
 from distributed_ba3c_tpu.utils import logger
 from distributed_ba3c_tpu.utils.concurrency import StoppableThread
 
@@ -146,7 +151,17 @@ class StaleParamsCache:
 
     # -- refresh internals ---------------------------------------------------
     def _apply(self, payload) -> None:
-        epoch, version, step, params = unpack_params(payload)
+        epoch, version, step, params, tr = unpack_params_full(payload)
+        # a sampled publish carries a trace context: handshake the
+        # learner's clock and park the ref so the apply leg below is
+        # attributed (publisher -> cache fetch, docs/observability.md)
+        ref = None
+        out = tracing.receive_context(
+            tracing.decode_context(tr), peer="pod-learner",
+            role=self.tele_role, wire_name="params_wire",
+        )
+        if out is not None:
+            ref = tracing.TraceRef(*out)
         if epoch != self.epoch:
             # a NEW publisher lifetime (first contact, or a restarted
             # learner whose versions regressed to 0): adopt it outright —
@@ -169,6 +184,9 @@ class StaleParamsCache:
                 cb(params, version)
             except Exception as e:  # a bad consumer must not kill refresh
                 logger.error("params cache on_update raised %r", e)
+        if ref is not None:
+            # decode + predictor swap, on this host's timeline
+            ref.hop("params_apply", self.tele_role, tags={"version": version})
         self._c_refreshes.inc()
         self._g_version.set(version)
         self._have_first.set()

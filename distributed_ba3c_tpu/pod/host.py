@@ -41,8 +41,9 @@ from typing import List, Optional
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.telemetry import tracing
 from distributed_ba3c_tpu.actors.vtrace_master import VTraceSimulatorMaster
-from distributed_ba3c_tpu.data.dataflow import collate_rollout
+from distributed_ba3c_tpu.data.dataflow import claim_trace, collate_rollout
 from distributed_ba3c_tpu.pod.cache import StaleParamsCache, VersionGatedPredictor
 from distributed_ba3c_tpu.pod.wire import pack_experience, pod_endpoints, pod_role
 from distributed_ba3c_tpu.utils import logger
@@ -123,10 +124,16 @@ class ExperienceShipper(StoppableThread):
 
         holder: List[dict] = []
         stamp = (0, 0)  # (epoch, version) at the block's first segment
+        trace = None  # sampled trace riding the block being collated
         while not self.stopped():
             seg = self.queue_get_stoppable(self.master.queue, timeout=0.2)
             if seg is None:
                 break
+            ref = claim_trace(seg)
+            if ref is not None:
+                # emit -> shipper drain: the host-side ship wait (one
+                # trace per shipped block, claimed once)
+                trace = trace or ref.hop("ship_wait", self.tele_role)
             if not holder:
                 stamp = (self.cache.epoch or 0, self.cache.version)
             holder.append(seg)
@@ -134,8 +141,18 @@ class ExperienceShipper(StoppableThread):
                 continue
             batch = collate_rollout(holder)
             holder = []
+            ctx = None
+            if trace is not None:
+                # collate on the host, then hand the trace across the
+                # process boundary: the context carries this host's
+                # monotonic stamp (clock handshake) so the learner's
+                # pod_wire span lands on one aligned timeline
+                trace = trace.hop("host_collate", self.tele_role)
+                ctx = tracing.encode_context(trace.trace_id, trace.parent_id)
+                trace = None
             frames = pack_experience(
-                self.host, stamp[1], batch, self._scalars(), epoch=stamp[0]
+                self.host, stamp[1], batch, self._scalars(), epoch=stamp[0],
+                trace=ctx,
             )
             try:
                 self._push.send_multipart(frames, zmq.NOBLOCK, copy=False)

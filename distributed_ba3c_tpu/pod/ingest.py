@@ -28,10 +28,11 @@ import numpy as np
 import zmq
 
 from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.telemetry import tracing
 from distributed_ba3c_tpu.pod.wire import (
     PodEndpoints,
     pod_role,
-    unpack_experience,
+    unpack_experience_full,
 )
 from distributed_ba3c_tpu.utils.concurrency import StoppableThread
 
@@ -46,6 +47,9 @@ class StampedBatch:
     #: publisher lifetime the version counts within (0 = unknown/legacy);
     #: the learner rejects blocks from a lineage it does not own
     epoch: int = 0
+    #: tracing.TraceRef when the shipping host sampled this block — the
+    #: cross-process continuation the learner's gate/step hops extend
+    trace: object = None
 
 
 class PodIngest:
@@ -75,6 +79,7 @@ class PodIngest:
         self._ready = threading.Condition(self._lock)
 
         tele = telemetry.registry(tele_role)
+        self._tele_role = tele_role
         self._c_blocks = tele.counter("pod_ingest_blocks_total")
         self._c_steps = tele.counter("pod_ingest_env_steps_total")
         self._c_dropped = tele.counter("pod_ingest_dropped_total")
@@ -153,8 +158,8 @@ class PodIngest:
             except (zmq.ContextTerminated, zmq.ZMQError):
                 return
             try:
-                host, epoch, version, scalars, batch = unpack_experience(
-                    [f.buffer for f in frames]
+                host, epoch, version, scalars, batch, tr = (
+                    unpack_experience_full([f.buffer for f in frames])
                 )
             except (ValueError, KeyError, TypeError) as e:
                 from distributed_ba3c_tpu.utils import logger
@@ -165,9 +170,21 @@ class PodIngest:
             self._c_blocks.inc()
             self._c_steps.inc(T * B)
             self._fold_host_scalars(host, scalars)
+            # sampled cross-host trace: handshake the host's clock,
+            # record the pod_wire transit span, carry the ref to the
+            # learner loop (StalenessGate / pod_learner_step hops)
+            trace = None
+            out = tracing.receive_context(
+                tracing.decode_context(tr), peer=pod_role(host),
+                role=self._tele_role, wire_name="pod_wire",
+            )
+            if out is not None:
+                trace = tracing.TraceRef(*out)
             with self._ready:
                 if len(self._buf) >= self._depth:
                     self._buf.popleft()
                     self._c_dropped.inc()
-                self._buf.append(StampedBatch(host, version, batch, epoch))
+                self._buf.append(
+                    StampedBatch(host, version, batch, epoch, trace)
+                )
                 self._ready.notify()

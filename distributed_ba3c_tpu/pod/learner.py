@@ -246,6 +246,7 @@ class PodLearner:
         self.learning_rate = cfg.learning_rate
         self.version = 0
         self.gate = StalenessGate(max_staleness, tele_role=tele_role)
+        self._tele_role = tele_role
         tele = telemetry.registry(tele_role)
         self._c_updates = tele.counter("pod_updates_total")
         self._c_epoch_mismatch = tele.counter("epoch_mismatch_blocks_total")
@@ -272,6 +273,7 @@ class PodLearner:
     def consume(self, stamped) -> Optional[dict]:
         """Gate + update on one ingest batch (pod/ingest.py StampedBatch);
         returns the update's metrics, or None when the block was rejected."""
+        ref = getattr(stamped, "trace", None)
         if (
             self.publisher is not None
             and getattr(stamped, "epoch", 0)
@@ -290,12 +292,36 @@ class PodLearner:
                 block_epoch=stamped.epoch,
                 learner_epoch=self.publisher.epoch,
             )
+            if ref is not None:
+                # same visibility contract as the staleness-gate
+                # rejection below: a rejected block's trace ENDS with a
+                # verdict span, never a silent disappearance
+                ref.hop(
+                    "epoch_gate", self._tele_role,
+                    tags={"rejected": True, "reason": "epoch_mismatch"},
+                )
             return None
         lag = self.gate.admit(stamped.version, self.version, stamped.host)
         if lag is None:
+            if ref is not None:
+                # the trace ends HERE, visibly: a rejected block's last
+                # span is the gate verdict, not a silent disappearance
+                ref.hop(
+                    "staleness_gate", self._tele_role,
+                    tags={"rejected": True, "lag": "over_bound"},
+                )
             return None
+        if ref is not None:
+            ref = ref.hop(
+                "staleness_gate", self._tele_role, tags={"lag": lag}
+            )
         block = batch_to_block(stamped.batch, self.step.block_sharding)
-        return self._update(block)
+        if ref is not None:
+            ref = ref.hop("pod_ingest_stage", self._tele_role)
+        out = self._update(block)
+        if ref is not None:
+            ref.hop("pod_learner_step", self._tele_role)
+        return out
 
     def consume_block(self, block: TrajBlock, block_version: int,
                       host: Optional[int] = None) -> Optional[dict]:

@@ -30,6 +30,7 @@ from typing import Any, Optional
 import zmq
 
 from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.telemetry import tracing
 from distributed_ba3c_tpu.pod.wire import PodEndpoints, pack_params
 from distributed_ba3c_tpu.utils import logger
 from distributed_ba3c_tpu.utils.concurrency import StoppableThread
@@ -110,8 +111,18 @@ class ParamsPublisher:
         ``params`` must already be host-side and learner-decoupled (the
         caller device_gets its own snapshot — this class never touches
         donated device buffers; see PodLearner.publish for the sanctioned
-        sequence)."""
-        payload = pack_params(version, params, step=step, epoch=self.epoch)
+        sequence). 1-in-N sampled publishes (by version — deterministic,
+        tracing.py) carry a trace context so every subscribing cache's
+        fetch/apply leg lands on one cross-host timeline."""
+        trace = None
+        if tracing.enabled() and tracing.sampled(version):
+            trace = tracing.encode_context(
+                tracing.make_id("params", self.epoch, version),
+                tracing.make_id("params", self.epoch, version, "origin"),
+            )
+        payload = pack_params(
+            version, params, step=step, epoch=self.epoch, trace=trace
+        )
         self._latest = payload
         self.version = int(version)
         self._g_version.set(self.version)

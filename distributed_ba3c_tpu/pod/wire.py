@@ -129,23 +129,42 @@ def _plain(tree: Any) -> Any:
 
 
 def pack_params(
-    version: int, params: Any, step: Optional[int] = None, epoch: int = 0
+    version: int,
+    params: Any,
+    step: Optional[int] = None,
+    epoch: int = 0,
+    trace: Optional[list] = None,
 ) -> bytes:
-    """One params snapshot message (PUB broadcast == fetch reply)."""
-    return dumps(
-        {
-            "e": int(epoch),
-            "v": int(version),
-            "step": int(step or 0),
-            "params": _plain(params),
-        }
-    )
+    """One params snapshot message (PUB broadcast == fetch reply).
+
+    ``trace`` is a sampled trace-context element
+    (telemetry/tracing.py ``encode_context``) riding as an optional
+    ``"tr"`` key — dict-keyed messages version by key presence the way
+    the block headers version by length; old receivers ignore it."""
+    doc = {
+        "e": int(epoch),
+        "v": int(version),
+        "step": int(step or 0),
+        "params": _plain(params),
+    }
+    if trace is not None:
+        doc["tr"] = trace
+    return dumps(doc)
 
 
 def unpack_params(payload) -> Tuple[int, int, int, Dict[str, Any]]:
     """Inverse of :func:`pack_params`: ``(epoch, version, step, params)``.
     The arrays are COPIES (not buffer views): the cache hands them to a
     predictor that outlives the zmq frame."""
+    return unpack_params_full(payload)[:4]
+
+
+def unpack_params_full(
+    payload,
+) -> Tuple[int, int, int, Dict[str, Any], Any]:
+    """:func:`unpack_params` plus the raw ``"tr"`` trace element (None
+    when absent) — the cache's decode path; the 4-tuple wrapper stays for
+    every pre-tracing caller."""
     doc = loads(payload)
     params = _copy_tree(doc["params"])
     return (
@@ -153,6 +172,7 @@ def unpack_params(payload) -> Tuple[int, int, int, Dict[str, Any]]:
         int(doc["v"]),
         int(doc.get("step", 0)),
         params,
+        doc.get("tr"),
     )
 
 
@@ -168,6 +188,7 @@ def pack_experience(
     batch: Dict[str, np.ndarray],
     scalars: Optional[Dict[str, float]] = None,
     epoch: int = 0,
+    trace: Optional[list] = None,
 ) -> List[Any]:
     """One stamped experience block as a zero-copy multipart message.
 
@@ -178,7 +199,9 @@ def pack_experience(
     conservative stamp the bounded-staleness gate measures lag from);
     ``epoch`` is the publisher lifetime the version counts within;
     ``scalars`` piggybacks the host's progress counters for the
-    learner-side ``pod.host<k>`` mirror.
+    learner-side ``pod.host<k>`` mirror; ``trace`` is a sampled
+    trace-context element (tracing.py) riding as an optional ``"tr"``
+    key — the cross-process continuation of the block's rollout trace.
     """
     missing = [k for k in EXPERIENCE_KEYS if k not in batch]
     if missing:
@@ -189,6 +212,8 @@ def pack_experience(
         "v": int(version),
         "scalars": scalars or {},
     }
+    if trace is not None:
+        meta["tr"] = trace
     return pack_block(meta, [batch[k] for k in EXPERIENCE_KEYS])
 
 
@@ -199,6 +224,14 @@ def unpack_experience(
     ``(host, epoch, version, scalars, batch)`` — arrays are zero-copy
     views over the frames (they keep the frames alive,
     serialize.unpack_block)."""
+    return unpack_experience_full(frames)[:5]
+
+
+def unpack_experience_full(
+    frames: Sequence[Any],
+) -> Tuple[int, int, int, Dict[str, float], Dict[str, np.ndarray], Any]:
+    """:func:`unpack_experience` plus the raw ``"tr"`` trace element
+    (None when absent) — the ingest's decode path."""
     meta, arrays = unpack_block(frames)
     if len(arrays) != len(EXPERIENCE_KEYS):
         raise ValueError(
@@ -212,4 +245,5 @@ def unpack_experience(
         int(meta["v"]),
         dict(meta["scalars"]),
         batch,
+        meta.get("tr") if isinstance(meta, dict) else None,
     )

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.telemetry import tracing as _tracing
 from distributed_ba3c_tpu.audit import tripwire_jit
 from distributed_ba3c_tpu.utils import logger
 from distributed_ba3c_tpu.utils.concurrency import (
@@ -104,10 +105,10 @@ class _BlockTask:
     """
 
     __slots__ = ("states", "callback", "k", "deadline", "policy", "shed_cb",
-                 "t_admit")
+                 "t_admit", "trace")
 
     def __init__(self, states, callback, deadline=None, policy=None,
-                 shed_cb=None):
+                 shed_cb=None, trace=None):
         self.states = states
         self.callback = callback
         self.k = states.shape[0]
@@ -115,16 +116,17 @@ class _BlockTask:
         self.policy = policy
         self.shed_cb = shed_cb
         self.t_admit = 0.0
+        self.trace = trace  # tracing.TraceRef for a sampled block step
 
 
 class _RowTask:
     """One single state row (per-env wire); ``k`` is always 1."""
 
     __slots__ = ("states", "callback", "k", "deadline", "policy", "shed_cb",
-                 "t_admit")
+                 "t_admit", "trace")
 
     def __init__(self, state, callback, deadline=None, policy=None,
-                 shed_cb=None):
+                 shed_cb=None, trace=None):
         self.states = state
         self.callback = callback
         self.k = 1
@@ -132,16 +134,17 @@ class _RowTask:
         self.policy = policy
         self.shed_cb = shed_cb
         self.t_admit = 0.0
+        self.trace = trace  # tracing.TraceRef for a sampled row
 
 
 class _Inflight:
     """One dispatched-not-yet-fetched device call the scheduler tracks."""
 
     __slots__ = ("tasks", "n", "policy", "handle", "t_dispatch", "t_oldest",
-                 "shadow", "states")
+                 "shadow", "states", "t_dispatch_us")
 
     def __init__(self, tasks, n, policy, handle, t_dispatch, t_oldest=0.0,
-                 shadow=False, states=None):
+                 shadow=False, states=None, t_dispatch_us=0):
         self.tasks = tasks        # ordered singles-then-blocks; None = shadow
         self.n = n
         self.policy = policy
@@ -153,6 +156,9 @@ class _Inflight:
         self.t_oldest = t_oldest
         self.shadow = shadow
         self.states = states      # batch kept only for a shadow tap
+        # µs dispatch stamp for trace spans (0 when no task is traced —
+        # the untraced path never reads the clock for it)
+        self.t_dispatch_us = t_dispatch_us
 
 
 def make_fwd_sample(model, greedy: bool = False) -> Callable:
@@ -434,6 +440,7 @@ class BatchedPredictor:
         deadline: Optional[float] = None,
         policy: Optional[str] = None,
         shed_callback: Optional[Callable[[ShedReject], None]] = None,
+        trace=None,
     ) -> bool:
         """Queue one state; ``callback(action, value, logp)`` fires when
         served — logp is log mu(action|state) under the sampling policy.
@@ -442,9 +449,12 @@ class BatchedPredictor:
         when set); a task that cannot make it is shed with a typed
         :class:`ShedReject` to ``shed_callback`` instead of served late.
         Tasks arriving after ``stop()`` are rejected the same way (their
-        simulators are being torn down too). Returns True if admitted."""
+        simulators are being torn down too). ``trace`` is a sampled
+        tracing.TraceRef — the scheduler attributes its dispatch-wait and
+        device-fetch spans under this predictor's role (tracing.py).
+        Returns True if admitted."""
         return self._admit(
-            _RowTask(state, callback, deadline, policy, shed_callback)
+            _RowTask(state, callback, deadline, policy, shed_callback, trace)
         )
 
     def put_block_task(
@@ -455,6 +465,7 @@ class BatchedPredictor:
         deadline: Optional[float] = None,
         policy: Optional[str] = None,
         shed_callback: Optional[Callable[[ShedReject], None]] = None,
+        trace=None,
     ) -> bool:
         """Queue one [B, ...] state block (the block wire's whole batch);
         ``callback(actions[B], values[B], logps[B])`` fires ONCE when the
@@ -462,7 +473,7 @@ class BatchedPredictor:
         unit — no per-row splitting; queued neighbors coalesce into one
         device call up to the bucket cap (continuous batching: the
         in-flight dispatch is the coalesce window). Same deadline/shed
-        semantics as :meth:`put_task`."""
+        semantics as :meth:`put_task`; ``trace`` as there."""
         cap = _next_pow2(max(self._batch_size, 1))
         if states.shape[0] > cap:
             self._c_oversize.inc()
@@ -472,7 +483,8 @@ class BatchedPredictor:
                 "the env-server block size"
             )
         return self._admit(
-            _BlockTask(states, callback, deadline, policy, shed_callback)
+            _BlockTask(states, callback, deadline, policy, shed_callback,
+                       trace)
         )
 
     def predict_batch(
@@ -771,10 +783,16 @@ class BatchedPredictor:
         t_oldest = tasks[0].t_admit
         self._h_queue_wait.observe(max(0.0, now - t_oldest))
         ordered = singles + blocks  # callback offsets follow batch layout
+        handle = self._dispatch(self._policies[policy], batch)
+        # µs stamp only when a sampled trace rides this group — the
+        # untraced path pays one attribute scan, never a clock read
+        t_us = (
+            _tracing.now_us()
+            if any(tk.trace is not None for tk in ordered) else 0
+        )
         out = [_Inflight(
-            ordered, weight, policy,
-            self._dispatch(self._policies[policy], batch), now,
-            t_oldest=t_oldest,
+            ordered, weight, policy, handle, now,
+            t_oldest=t_oldest, t_dispatch_us=t_us,
         )]
         shadow = self._shadow
         if shadow is not None:
@@ -810,6 +828,18 @@ class BatchedPredictor:
             return
         actions, values, logps, _ = self._collect(inf.handle)
         now = self._clock()
+        if inf.t_dispatch_us:
+            # sampled spans: dispatch wait (admit -> device dispatch) and
+            # device fetch (dispatch -> results on host) attributed under
+            # THIS predictor's role — the decomposition of the master-side
+            # predict RTT span (tracing.py; docs/observability.md)
+            for tk in inf.tasks:
+                if tk.trace is not None:
+                    tk.trace.hop(
+                        "predict_dispatch", self.tele_role,
+                        t_end_us=inf.t_dispatch_us,
+                    ).hop("predict_fetch", self.tele_role)
+                    tk.trace = None  # one attribution per task
         # decaying-max serve-time estimate for the deadline gate: tracks
         # the worst recent dispatch->fetch (incl. pipeline wait) and decays
         # 10% per call so a one-off stall doesn't shed forever

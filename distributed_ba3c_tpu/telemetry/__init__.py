@@ -14,8 +14,12 @@ Four layers over the PR-4 actor plane:
    counter deltas on the existing wire headers (length-versioned; old
    headers still parse); the master folds them into the ``fleet`` registry.
 4. **Exporters** (telemetry/exporters.py) — ``--telemetry_port`` scrape
-   endpoint (Prometheus text + /json + /flight) and the stat.json/TB
-   bridge StatPrinter uses.
+   endpoint (Prometheus text + /json + /flight + /trace) and the
+   stat.json/TB bridge StatPrinter uses.
+5. **Trace plane** (telemetry/tracing.py) — sampled causal block-lifetime
+   spans with per-hop latency attribution; context rides the same wire
+   headers as the fleet deltas, exported via ``/trace`` and
+   ``scripts/trace_dump.py`` (Perfetto).
 
 The usual import is the package itself::
 
@@ -58,3 +62,4 @@ from distributed_ba3c_tpu.telemetry.wire import (  # noqa: F401
     DeltaTracker,
     apply_fleet_deltas,
 )
+from distributed_ba3c_tpu.telemetry import tracing  # noqa: F401

@@ -8,7 +8,10 @@ fleet):
   ``role`` label; histograms as cumulative ``_bucket{le=...}`` +
   ``_sum``/``_count``).
 - ``GET /json``    — the raw :func:`metrics.all_snapshots` document.
-- ``GET /flight``  — the flight recorder's current ring (live, no dump).
+- ``GET /flight``  — the flight recorder's current ring (live, no dump);
+  ``?since=<t_monotonic>&kind=<kind>`` filters via ``events_since``.
+- ``GET /trace``   — the trace plane's buffered spans + clock offsets
+  (telemetry/tracing.py; feed to ``scripts/trace_dump.py``).
 - ``GET /``        — a one-line index.
 
 The stat.json/TB bridge is :func:`export_scalars` — StatPrinter folds it
@@ -21,9 +24,10 @@ from __future__ import annotations
 import http.server
 import json
 import threading
+import urllib.parse
 from typing import Dict, Optional
 
-from distributed_ba3c_tpu.telemetry import metrics, recorder
+from distributed_ba3c_tpu.telemetry import metrics, recorder, tracing
 
 
 def prometheus_text(snapshots: Optional[Dict[str, Dict[str, dict]]] = None) -> str:
@@ -109,26 +113,56 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 (stdlib API name)
         try:
-            if self.path.startswith("/metrics"):
+            path, _, query = self.path.partition("?")
+            if path.startswith("/metrics"):
                 self._send(prometheus_text(), "text/plain; version=0.0.4")
-            elif self.path.startswith("/json"):
+            elif path.startswith("/json"):
                 self._send(
                     json.dumps(metrics.all_snapshots()), "application/json"
                 )
-            elif self.path.startswith("/flight"):
+            elif path.startswith("/flight"):
+                self._send(json.dumps(self._flight(query)), "application/json")
+            elif path.startswith("/trace"):
+                # the trace plane's scrape: buffered spans + per-peer
+                # clock offsets + the monotonic/wall anchor pair —
+                # scripts/trace_dump.py merges one or more of these into
+                # Chrome trace-event / Perfetto JSON
                 self._send(
-                    json.dumps(recorder.flight_recorder().snapshot()),
+                    json.dumps(tracing.tracer().document()),
                     "application/json",
                 )
-            elif self.path == "/":
+            elif path == "/":
                 self._send(
-                    "ba3c telemetry: /metrics (prometheus), /json, /flight\n",
+                    "ba3c telemetry: /metrics (prometheus), /json, "
+                    "/flight[?since=&kind=], /trace\n",
                     "text/plain",
                 )
             else:
                 self.send_error(404)
         except (BrokenPipeError, ConnectionResetError):
             pass  # scraper went away mid-response
+
+    @staticmethod
+    def _flight(query: str) -> list:
+        """The flight ring, optionally filtered: ``?since=<t_monotonic>``
+        and/or ``?kind=<event kind>`` expose the recorder's existing
+        ``events_since`` filter over HTTP — a postmortem poll that wants
+        "prunes since my last scrape" no longer re-downloads (and
+        re-diffs) the whole ring. Junk params read as unfiltered/ignored
+        rather than erroring the scrape."""
+        params = urllib.parse.parse_qs(query)
+        kind = params.get("kind", [None])[0] or None
+        since = params.get("since", [None])[0]
+        if since is None and kind is None:
+            return recorder.flight_recorder().snapshot()
+        try:
+            t = float(since) if since is not None else float("-inf")
+        except ValueError:
+            t = float("-inf")
+        return [
+            {"t_monotonic": ev[0], "kind": ev[1], **ev[2]}
+            for ev in recorder.flight_recorder().events_since(t, kind)
+        ]
 
     def log_message(self, fmt, *args):  # scrapes must not spam the run log
         pass
@@ -162,7 +196,8 @@ class TelemetryServer:
         from distributed_ba3c_tpu.utils import logger
 
         logger.info(
-            "telemetry scrape endpoint on :%d (/metrics, /json, /flight)",
+            "telemetry scrape endpoint on :%d "
+            "(/metrics, /json, /flight, /trace)",
             self.port,
         )
 

@@ -289,6 +289,10 @@ def reset_all() -> None:
     # block-wire idents are stable per fleet x slot, so a back-to-back
     # same-process bench run would otherwise count the PREVIOUS run's
     # senders in reporting_clients for up to the liveness window
-    from distributed_ba3c_tpu.telemetry import wire
+    from distributed_ba3c_tpu.telemetry import tracing, wire
 
     wire._FLEET_SEEN.clear()
+    # buffered spans and peer clock offsets are per-run evidence the same
+    # way counters are: a back-to-back bench session must not export the
+    # previous run's spans (or align against its dead senders' clocks)
+    tracing.reset()

@@ -33,6 +33,7 @@ import time
 from typing import Optional
 
 from distributed_ba3c_tpu.telemetry import metrics as _metrics
+from distributed_ba3c_tpu.telemetry import tracing as _tracing
 
 DEFAULT_CAPACITY = 4096
 
@@ -57,9 +58,17 @@ class FlightRecorder:
         self._dump_lock = threading.Lock()
 
     def record(self, kind: str, **fields) -> None:
-        """Append one event — a single deque append, safe from any thread."""
+        """Append one event — a single deque append, safe from any thread.
+
+        When a sampled trace is in scope on this thread (tracing.py
+        ``trace_scope``), the event is stamped with its trace id so a
+        postmortem dump correlates with the ``/trace`` spans — one
+        thread-local read on the record path, nothing more."""
         if not _metrics.enabled():
             return
+        tr = _tracing.current_trace_id()
+        if tr is not None and "trace_id" not in fields:
+            fields["trace_id"] = tr
         self._ring.append((time.monotonic(), kind, fields))
 
     def snapshot(self) -> list:

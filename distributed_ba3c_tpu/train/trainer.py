@@ -84,6 +84,7 @@ class Trainer:
         self.last_mean_score: Optional[float] = None
         self.ckpt_manager = None  # set by ModelSaver
         self.metrics = None
+        self._pending_trace = None  # sampled trace between stage + step
         self._callbacks = Callbacks(callbacks)
 
         # telemetry (docs/observability.md): the learner registry is the
@@ -141,10 +142,18 @@ class Trainer:
 
     def _next_device_batch(self):
         batch = self.feed.next_batch(timeout=self.config.feed_timeout)
+        # a sampled trace rode the batch through the feed (tracing.py):
+        # claim it before staging — device_put must never see the ref
+        trace = batch.pop("_trace", None)
         sharding = self.step_fn.batch_sharding
         if isinstance(sharding, dict):
-            return {k: self._put(v, sharding[k]) for k, v in batch.items()}
-        return {k: self._put(v, sharding) for k, v in batch.items()}
+            out = {k: self._put(v, sharding[k]) for k, v in batch.items()}
+        else:
+            out = {k: self._put(v, sharding) for k, v in batch.items()}
+        if trace is not None:
+            # feed handoff -> staged on device (host-side ingest hop)
+            self._pending_trace = trace.hop("ingest", "learner")
+        return out
 
     def run_step(self) -> None:
         # Overlap note: step_fn dispatch is ASYNC, so fetching/staging the
@@ -156,13 +165,40 @@ class Trainer:
         # callbacks that fetch metrics (StatPrinter samples every N steps).
         t0 = time.monotonic()
         batch = self._next_device_batch()
-        self.state, self.metrics = self.step_fn(
-            self.state,
-            batch,
-            self.hyperparams["entropy_beta"],
-            self.hyperparams["learning_rate"],
-        )
+        if self._pending_trace is not None:
+            # sampled steps only: the jax.profiler step region carries the
+            # trace/span ids, so a chip-session capture lines up with the
+            # host spans by id (utils/profiling.py; no-op cost when no
+            # profiler session is attached)
+            from distributed_ba3c_tpu.utils.profiling import step_annotation
+
+            with step_annotation(
+                "train_step", self.global_step,
+                trace_id=self._pending_trace.trace_id,
+                span_id=self._pending_trace.parent_id,
+            ):
+                self.state, self.metrics = self.step_fn(
+                    self.state,
+                    batch,
+                    self.hyperparams["entropy_beta"],
+                    self.hyperparams["learning_rate"],
+                )
+        else:
+            self.state, self.metrics = self.step_fn(
+                self.state,
+                batch,
+                self.hyperparams["entropy_beta"],
+                self.hyperparams["learning_rate"],
+            )
         self.global_step += 1
+        if self._pending_trace is not None:
+            # host-side dispatch of the update (device execution is async;
+            # a chip-session jax.profiler capture correlates via the
+            # step_annotation trace/span tags — utils/profiling.py)
+            self._pending_trace.hop(
+                "learner_step", "learner", tags={"step": self.global_step}
+            )
+            self._pending_trace = None
         # step latency here covers feed wait + staging + async dispatch —
         # the host-side budget (device execution overlaps the next call)
         self._h_step.observe(time.monotonic() - t0)

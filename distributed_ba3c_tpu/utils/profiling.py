@@ -41,11 +41,29 @@ def start_server(port: int) -> None:
 
 
 @contextlib.contextmanager
-def step_annotation(name: str, step: int):
-    """Named trace region for one step (shows up in captured timelines)."""
+def step_annotation(
+    name: str,
+    step: int,
+    trace_id: int = None,
+    span_id: int = None,
+):
+    """Named trace region for one step (shows up in captured timelines).
+
+    ``trace_id``/``span_id`` correlate a chip-session ``jax.profiler``
+    capture with the host-side trace plane (telemetry/tracing.py): pass
+    the active block trace's ids (``tracing.current_trace_id()``, or a
+    TraceRef's fields) and the device timeline's step region carries them
+    as metadata — line the Perfetto export of ``scripts/trace_dump.py``
+    up against the XLA capture by matching the ids (ROADMAP item 1's
+    on-chip captures land next to host spans instead of in a vacuum)."""
     import jax
 
-    with jax.profiler.StepTraceAnnotation(name, step_num=step):
+    kwargs = {"step_num": step}
+    if trace_id is not None:
+        kwargs["trace_id"] = int(trace_id)
+    if span_id is not None:
+        kwargs["span_id"] = int(span_id)
+    with jax.profiler.StepTraceAnnotation(name, **kwargs):
         yield
 
 

@@ -44,6 +44,172 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 
+def run_trace_capture(
+    game: str = "pong",
+    sample: int = 32,
+    n_envs: int = 64,
+    unroll_len: int = 5,
+    feed_batch: int = 4,
+    min_traces: int = 3,
+    timeout_s: float = 120.0,
+):
+    """One traced block-shm plane through a REAL (CPU) V-trace learner.
+
+    C++ env server (block-shm, trace contexts stamped 1-in-``sample``) →
+    master → null predictor → unroll flush → RolloutFeed → device staging
+    → the actual jitted ``parallel.vtrace_step`` — the full causal chain
+    the trace plane exists to attribute, run until ``min_traces``
+    complete env-step→learner-step traces are buffered. Returns
+    ``(capture_dict, gate_failures)``; the capture embeds the raw
+    ``/trace`` document plus a per-hop summary of one complete trace.
+    """
+    import queue
+    import tempfile
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from distributed_ba3c_tpu import telemetry
+    from distributed_ba3c_tpu.telemetry import tracing
+    from distributed_ba3c_tpu.actors.vtrace_master import VTraceSimulatorMaster
+    from distributed_ba3c_tpu.config import BA3CConfig
+    from distributed_ba3c_tpu.data.dataflow import RolloutFeed
+    from distributed_ba3c_tpu.envs import native
+    from distributed_ba3c_tpu.models.a3c import BA3CNet
+    from distributed_ba3c_tpu.ops.gradproc import make_optimizer
+    from distributed_ba3c_tpu.parallel.mesh import make_mesh
+    from distributed_ba3c_tpu.parallel.train_step import create_train_state
+    from distributed_ba3c_tpu.parallel.vtrace_step import make_vtrace_train_step
+
+    from bench import make_null_predictor
+    from distributed_ba3c_tpu.utils.devicelock import stderr_print
+
+    telemetry.reset_all()
+    telemetry.set_enabled(True)
+    os.environ["BA3C_TELEMETRY"] = "1"
+    tracing.set_sampling(sample)
+    os.environ["BA3C_TRACE"] = str(sample)
+
+    n_actions = native.CppBatchedEnv(game, 1).num_actions
+    cfg = BA3CConfig(num_actions=n_actions, predict_batch_size=max(64, n_envs))
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, *cfg.state_shape), np.uint8)
+    )["params"]
+    mesh = make_mesh(num_model=1)
+    step_fn = make_vtrace_train_step(
+        model, make_optimizer(cfg.learning_rate, cfg.adam_epsilon,
+                              cfg.grad_clip_norm), cfg, mesh,
+    )
+    state = jax.device_put(
+        create_train_state(
+            jax.random.PRNGKey(0), model, cfg,
+            make_optimizer(cfg.learning_rate, cfg.adam_epsilon,
+                           cfg.grad_clip_norm),
+        ),
+        step_fn.state_sharding,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="ba3c-trace-cap-")
+    c2s, s2c = f"ipc://{tmp}/c2s", f"ipc://{tmp}/s2c"
+    predictor = make_null_predictor(
+        model, params, n_actions, batch_size=max(64, n_envs), coalesce_ms=0.0,
+    )
+    master = VTraceSimulatorMaster(
+        c2s, s2c, predictor, unroll_len=unroll_len,
+        train_queue=queue.Queue(maxsize=256),
+    )
+    master.feed_batch = feed_batch
+    feed = RolloutFeed(master.queue, batch_size=feed_batch)
+    proc = native.CppEnvServerProcess(  # ba3clint: disable=A8 — raw plane is the measurand, like bench_zmq_plane
+        0, c2s, s2c, game=game, n_envs=n_envs, wire="block-shm",
+    )
+    completed = 0
+    steps = 0
+    failures = []
+    try:
+        predictor.start()
+        master.start()
+        feed.start()
+        proc.start()
+        deadline = _time.monotonic() + timeout_s
+        while completed < min_traces and _time.monotonic() < deadline:
+            try:
+                batch = feed.next_batch(timeout=10)
+            except queue.Empty:
+                continue
+            ref = batch.pop("_trace", None)
+            staged = {
+                k: jax.device_put(v, step_fn.batch_sharding[k])
+                for k, v in batch.items()
+            }
+            if ref is not None:
+                ref = ref.hop("ingest", "learner")
+            state, _metrics = step_fn(
+                state, staged, cfg.entropy_beta, cfg.learning_rate
+            )
+            steps += 1
+            if ref is not None:
+                ref.hop("learner_step", "learner")
+                completed += 1
+    finally:
+        proc.terminate()
+        feed.stop()
+        master.close()
+        predictor.stop()
+        predictor.join(timeout=5)
+        feed.join(timeout=2)
+
+    doc = tracing.tracer().document()
+    # pick ONE complete trace (env_step AND learner_step present) and
+    # summarize its named hops in causal order
+    by_trace = {}
+    for s in doc["spans"]:
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    chain = None
+    for spans in by_trace.values():
+        names = {s["name"] for s in spans}
+        if "env_step" in names and "learner_step" in names:
+            chain = sorted(spans, key=lambda s: s["ts_us"])
+            break
+    hop_hists = {
+        f"{role}/{name}": m
+        for role, series in telemetry.all_snapshots().items()
+        for name, m in series.items()
+        if name.startswith("hop_")
+    }
+    capture = {
+        "game": game, "n_envs": n_envs, "wire": "block-shm",
+        "sample_n": sample, "learner_steps": steps,
+        "completed_traces": completed,
+        "one_block_chain": [
+            {"name": s["name"], "role": s["role"], "dur_us": s["dur_us"]}
+            for s in (chain or [])
+        ],
+        "hop_histograms": hop_hists,
+        "document": doc,
+    }
+    if chain is None:
+        failures.append(
+            "trace capture FAILED: no complete env-step->learner-step "
+            f"trace after {steps} learner steps (completed={completed})"
+        )
+    elif len({s["name"] for s in chain}) < 6:
+        failures.append(
+            "trace capture FAILED: complete trace has fewer than 6 named "
+            f"hops: {[s['name'] for s in chain]}"
+        )
+    else:
+        stderr_print(
+            "trace capture: one block-shm chain = "
+            + " -> ".join(
+                f"{s['name']}({s['dur_us']}us)" for s in chain
+            )
+        )
+    return capture, failures
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--game", default="pong")
@@ -114,6 +280,23 @@ def main() -> int:
         "is a coin flip against this container's run-to-run scheduler "
         "variance (PERF.md round 7)",
     )
+    ap.add_argument(
+        "--trace", default="off", choices=["on", "off", "both"],
+        help="distributed trace plane A/B (telemetry/tracing.py): on = "
+        "run with 1-in---trace_sample block sampling armed, off = "
+        "tracing disarmed (the default), both = alternate off/on reps "
+        "per wire in one session and FAIL unless the MEDIAN traced rate "
+        "stays within 2%% of the median untraced rate (same methodology "
+        "as --telemetry both; telemetry stays ON in both arms so the "
+        "gate measures tracing's own marginal cost). on/both also run a "
+        "block-shm capture through a REAL CPU V-trace learner and embed "
+        "one complete env-step->learner-step trace under 'trace' in the "
+        "JSON (runs/trace_bench_r13.json)",
+    )
+    ap.add_argument(
+        "--trace_sample", type=int, default=64,
+        help="1-in-N block sampling rate for the --trace arms",
+    )
     args = ap.parse_args()
 
     wires = [w.strip() for w in args.wires.split(",") if w.strip()]
@@ -152,6 +335,7 @@ def main() -> int:
 
     runs = {}
     overhead = {}
+    trace_overhead = {}
     fleet_scaling = {}
     gate_failures = []
     for wire in wires:
@@ -221,6 +405,9 @@ def main() -> int:
                 game=args.game, n_envs=n_envs, seconds=args.seconds,
                 null_device=True, wire=wire, envs_per_proc=per,
                 windows=args.windows, telemetry_on=args.telemetry != "off",
+                trace_sample=(
+                    args.trace_sample if args.trace == "on" else 0
+                ),
             )
             if wire == "per-env":
                 # the foil's fleet shape is part of the number — rows are
@@ -230,6 +417,49 @@ def main() -> int:
             stderr_print(
                 f"device-free {wire:8s}: {r['value']:>10.1f} env-steps/s/host"
             )
+        if args.trace == "both":
+            # tracing overhead gate: SAME alternating-medians methodology
+            # as the telemetry gate above (and the same honest reason —
+            # this container's scheduler variance dwarfs a 2% budget on
+            # any single pair). Telemetry stays ON in both arms: the gate
+            # measures the TRACE plane's marginal cost over the already-
+            # gated telemetry baseline, not the sum of both planes.
+            off_vals, on_vals = [], []
+            for rep in range(max(1, args.pair_reps)):
+                for tr_on in (False, True) if rep % 2 == 0 else (True, False):
+                    r = bench_zmq_plane(
+                        game=args.game, n_envs=n_envs, seconds=args.seconds,
+                        null_device=True, wire=wire, envs_per_proc=per,
+                        windows=args.windows, telemetry_on=True,
+                        trace_sample=args.trace_sample if tr_on else 0,
+                    )
+                    tag = "on" if tr_on else "off"
+                    (on_vals if tr_on else off_vals).append(r["value"])
+                    runs[f"nodevice_{wire}_trace_{tag}_rep{rep}"] = r
+                    stderr_print(
+                        f"device-free {wire:8s} (trace {tag:3s}, rep {rep}): "
+                        f"{r['value']:>10.1f} env-steps/s/host"
+                    )
+            med_off = statistics.median(off_vals)
+            med_on = statistics.median(on_vals)
+            ratio = med_on / max(med_off, 1e-9)
+            trace_overhead[wire] = {
+                "sample_n": args.trace_sample,
+                "median_off": med_off, "median_on": med_on,
+                "on_over_off": round(ratio, 4),
+                "off_reps": off_vals, "on_reps": on_vals,
+            }
+            stderr_print(
+                f"trace overhead {wire}: median on/off = "
+                f"{med_on:.1f}/{med_off:.1f} = {ratio:.4f}"
+            )
+            if ratio < 0.98:
+                gate_failures.append(
+                    f"trace overhead gate FAILED on {wire}: median "
+                    f"traced rate {med_on:.1f} is {100 * (1 - ratio):.1f}% "
+                    f"below the median untraced rate {med_off:.1f} "
+                    "(budget: 2%)"
+                )
         if args.fleets > 1:
             # the multi-fleet arm at the SAME per-fleet shape, same
             # session (this container's run-to-run scheduler drift makes
@@ -299,6 +529,18 @@ def main() -> int:
         # ratio per wire, all measured alternating in THIS session
         # (PERF.md round 7 cites it)
         out["telemetry_overhead_on_over_off"] = overhead
+    if trace_overhead:
+        out["trace_overhead_on_over_off"] = trace_overhead
+    if args.trace in ("on", "both"):
+        # one REAL traced block-shm run through a CPU V-trace learner:
+        # the committed evidence that a sampled block's causal chain is
+        # complete env-step -> learner-step (runs/trace_bench_r13.json)
+        capture, cap_failures = run_trace_capture(
+            game=args.game, sample=args.trace_sample,
+        )
+        out["trace"] = capture.pop("document")
+        out["trace_capture"] = capture
+        gate_failures.extend(cap_failures)
     if fleet_scaling:
         # the multi-fleet scaling gate's evidence: single vs aggregate at
         # equal per-fleet shape, same session (ISSUE-10 acceptance)

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Render /trace documents as Chrome trace-event / Perfetto JSON.
+
+Inputs are one or more trace documents — live scrapes
+(``http://host:9100/trace``), saved scrape files, or bench JSONs that
+embed one under a ``"trace"`` key (``plane_bench --trace``). Every
+process's spans are merged onto ONE timeline and written in the Chrome
+trace-event format, which ``chrome://tracing`` and https://ui.perfetto.dev
+both load directly:
+
+    python scripts/trace_dump.py http://localhost:9100/trace -o trace.json
+    python scripts/trace_dump.py learner.json host0.json host1.json \\
+        -o pod_trace.json
+    python scripts/trace_dump.py runs/trace_bench_r13.json --validate
+
+Timeline merge: the FIRST input is the root. Each document carries a
+``(anchor_monotonic_us, anchor_wall)`` pair (the flight recorder's anchor
+idiom), so another process's monotonic timeline maps onto the root's
+through wall time. That is NTP-quality alignment between machines; the
+finer signal — the min-filtered monotonic-offset handshake each receiver
+measured against its wire peers (telemetry/tracing.py) — ships verbatim
+under ``clock_offsets_us`` in each document and in the output's
+``metadata`` for exact per-peer analysis. Spans a receiver synthesized
+for a remote sender (env_step, wire, pod_wire) were ALREADY aligned
+through that handshake at receive time, so single-scrape dumps need no
+merge step at all.
+
+``--validate`` checks the emitted JSON against the trace-event schema the
+CI ``tracing`` job gates on: required keys per event, complete (``ph: X``)
+events with non-negative ``dur``, and monotone ``ts`` within each
+``(pid, tid)`` track.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import List, Tuple
+
+
+def load_document(source: str) -> dict:
+    """One trace document from a URL, a scrape file, or a bench JSON
+    embedding it under ``"trace"``."""
+    if source.startswith(("http://", "https://")):
+        with urllib.request.urlopen(source, timeout=10) as resp:
+            doc = json.load(resp)
+    else:
+        with open(source) as fh:
+            doc = json.load(fh)
+    if "spans" not in doc and isinstance(doc.get("trace"), dict):
+        doc = doc["trace"]
+    if "spans" not in doc:
+        raise ValueError(
+            f"{source}: not a trace document (no 'spans'; scrape "
+            "/trace — not /json — or pass a plane_bench --trace JSON)"
+        )
+    return doc
+
+
+def merge_events(docs: List[dict]) -> Tuple[List[dict], dict]:
+    """Chrome trace events + metadata from one or more documents.
+
+    The first document's monotonic timeline is the root; later documents
+    shift onto it through the wall anchors (see module docstring)."""
+    root = docs[0]
+    root_mono = float(root.get("anchor_monotonic_us", 0))
+    root_wall = float(root.get("anchor_wall", 0.0))
+    events: List[dict] = []
+    offsets = {}
+    for idx, doc in enumerate(docs):
+        real_pid = int(doc.get("pid", 0))
+        # the Chrome pid is the DOCUMENT index, not the OS pid: two hosts'
+        # containers commonly share a pid (often both 1), and a bare-pid
+        # key would merge their tracks and overwrite the first host's
+        # alignment metadata; the real pid survives in the track name
+        pid = idx
+        shift = 0.0
+        if doc is not root and root_wall and doc.get("anchor_wall"):
+            # doc-local mono -> wall -> root-local mono
+            shift = (
+                (float(doc["anchor_wall"]) - root_wall) * 1e6
+                + root_mono
+                - float(doc.get("anchor_monotonic_us", 0))
+            )
+        offsets[f"doc{idx}"] = {
+            "source_pid": real_pid,
+            "shift_us": shift,
+            "clock_offsets_us": doc.get("clock_offsets_us", {}),
+            "dropped_spans": doc.get("dropped_spans", 0),
+        }
+        roles = set()
+        for s in doc["spans"]:
+            role = s.get("role", "?")
+            roles.add(role)
+            args = {
+                "trace_id": f"{int(s['trace_id']):016x}",
+                "span_id": f"{int(s['span_id']):016x}",
+                "parent_id": f"{int(s.get('parent_id', 0)):016x}",
+            }
+            if s.get("tags"):
+                args.update(s["tags"])
+            events.append({
+                "name": s["name"],
+                "cat": role,
+                "ph": "X",
+                "ts": float(s["ts_us"]) + shift,
+                "dur": max(0.0, float(s.get("dur_us", 0))),
+                "pid": pid,
+                "tid": role,  # one track per role within the process
+                "args": args,
+            })
+        # metadata events name the process tracks in the Perfetto UI
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"ba3c doc{idx} (pid {real_pid})"},
+        })
+    events.sort(key=lambda e: (e["ph"] == "M", e.get("ts", 0)))
+    return events, offsets
+
+
+def validate(events: List[dict]) -> List[str]:
+    """Schema check the CI smoke gates on; returns problem strings."""
+    problems = []
+    last_ts: dict = {}
+    for i, ev in enumerate(events):
+        if ev.get("ph") == "M":
+            if "name" not in ev or "args" not in ev:
+                problems.append(f"event {i}: metadata event missing name/args")
+            continue
+        missing = [k for k in ("name", "ph", "ts", "dur", "pid", "tid")
+                   if k not in ev]
+        if missing:
+            problems.append(f"event {i}: missing keys {missing}")
+            continue
+        if ev["ph"] != "X":
+            problems.append(f"event {i}: unexpected phase {ev['ph']!r}")
+        if ev["dur"] < 0:
+            problems.append(f"event {i}: negative dur {ev['dur']}")
+        track = (ev["pid"], ev["tid"])
+        if ev["ts"] < last_ts.get(track, float("-inf")):
+            problems.append(
+                f"event {i}: ts {ev['ts']} not monotone within track {track}"
+            )
+        last_ts[track] = ev["ts"]
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "inputs", nargs="+",
+        help="trace documents: /trace URLs, scrape files, or bench JSONs "
+        "with an embedded 'trace' key; the FIRST is the root timeline",
+    )
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default: stdout)")
+    ap.add_argument(
+        "--validate", action="store_true",
+        help="check the emitted events against the trace-event schema "
+        "(required keys, monotone ts per track) and exit non-zero on "
+        "any problem — the CI tracing job's smoke",
+    )
+    args = ap.parse_args(argv)
+
+    docs = [load_document(s) for s in args.inputs]
+    events, offsets = merge_events(docs)
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "tool": "distributed_ba3c_tpu scripts/trace_dump.py",
+            "root": args.inputs[0],
+            "alignment": offsets,
+        },
+    }
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(doc, fh)
+        print(
+            f"{len(events)} events from {len(docs)} process(es) -> "
+            f"{args.out} (load in chrome://tracing or ui.perfetto.dev)",
+            file=sys.stderr,
+        )
+    else:
+        json.dump(doc, sys.stdout)
+        print(file=sys.stdout)
+    if args.validate:
+        problems = validate(events)
+        for p in problems:
+            print(f"VALIDATE: {p}", file=sys.stderr)
+        if problems:
+            return 1
+        print(f"validated {len(events)} events OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+"""A11 clean fixture: the sanctioned span / monotonic-pair shapes."""
+import time
+
+from distributed_ba3c_tpu.telemetry import tracing
+
+
+def context_manager_span(trace_id, parent_id):
+    with tracing.span(trace_id, "collate", "learner", parent=parent_id):
+        return 1
+
+
+def explicit_finish(trace_id):
+    s = tracing.span(trace_id, "ingest", "learner")
+    try:
+        return 1
+    finally:
+        s.finish()
+
+
+def monotonic_into_histogram(hist, t0):
+    # the sanctioned in-place shape: the pair feeds the telemetry plane
+    # in the same statement
+    hist.observe(time.monotonic() - t0)
+
+
+def monotonic_non_metric(t0, deadline_s):
+    # duration math that is not metric accounting (timeouts, waits)
+    # stays fine — A11 polices latency *reporting*, not arithmetic
+    remaining = deadline_s - (time.monotonic() - t0)
+    return remaining > 0

@@ -1,0 +1,24 @@
+"""A11 fixture: orphan spans + ad-hoc monotonic-pair latency math."""
+import time
+
+from distributed_ba3c_tpu.telemetry import tracing
+
+
+def orphan_bare(trace_id, parent_id):
+    # constructed and dropped: never a with-item, never finish()ed
+    tracing.span(trace_id, "collate", "learner", parent=parent_id)
+
+
+def orphan_assigned(trace_id):
+    s = tracing.span(trace_id, "ingest", "learner")
+    return s  # escapes without finish() on this path
+
+
+def adhoc_monotonic_latency(t0):
+    latency = time.monotonic() - t0
+    return latency
+
+
+def adhoc_monotonic_rate(n, t0):
+    rate = n / (time.monotonic() - t0)
+    return rate

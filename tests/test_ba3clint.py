@@ -19,7 +19,7 @@ from tools.ba3clint.engine import suppressions
 FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-RULE_IDS = ["J1", "J2", "J3", "J4", "J5", "J6", "A1", "A2", "A3", "A4", "A5", "A6", "A7", "A8", "A9", "A10"]
+RULE_IDS = ["J1", "J2", "J3", "J4", "J5", "J6", "A1", "A2", "A3", "A4", "A5", "A6", "A7", "A8", "A9", "A10", "A11"]
 
 
 def _fixture(name):
@@ -75,6 +75,7 @@ def test_expected_flag_counts():
     assert len(_findings("a7_flagged.py", "A7")) == 4
     assert len(_findings("j6_flagged.py", "J6")) == 4
     assert len(_findings("a9_flagged.py", "A9")) == 5
+    assert len(_findings("a11_flagged.py", "A11")) == 4
 
 
 def test_a7_exempts_telemetry_package(tmp_path):

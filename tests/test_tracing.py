@@ -1,0 +1,679 @@
+"""The distributed trace plane (telemetry/tracing.py, docs/observability.md).
+
+Span-buffer units (sharded append, bounded drop, sampling determinism),
+the wire context codec (round trip + unknown-version tolerance + junk
+posture), clock-offset handshake/alignment, the live block-wire e2e (one
+sampled block's trace must be COMPLETE and CAUSALLY ORDERED across
+master/predictor/learner spans), the 2-host pod e2e (cross-process spans
+land clock-aligned on the learner's timeline), the /trace and filtered
+/flight endpoints, and the trace_dump.py Chrome-trace-event smoke the CI
+``tracing`` job gates on.
+"""
+
+import json
+import queue
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.telemetry import tracing
+from distributed_ba3c_tpu.utils.serialize import pack_block
+
+REPO_ROOT = __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_trace_plane():
+    """Every test starts with a clean tracer and sampling DISARMED (the
+    process default); nothing leaks into neighboring test files."""
+    tracing.reset()
+    tracing.set_sampling(0)
+    yield
+    tracing.reset()
+    tracing.set_sampling(0)
+
+
+# -- span buffer units -----------------------------------------------------
+
+
+def test_span_buffer_sharded_append_thread_exact():
+    buf = tracing.SpanBuffer(capacity=10_000)
+    n_threads, per = 8, 500
+
+    def writer(k):
+        for i in range(per):
+            buf.add((1, k * per + i, 0, "hop", "r", i, 1, None))
+
+    threads = [threading.Thread(target=writer, args=(k,)) for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(buf) == n_threads * per
+    assert buf.dropped == 0
+    spans = buf.snapshot()
+    assert len(spans) == n_threads * per
+    assert {s["span_id"] for s in spans} == set(range(n_threads * per))
+
+
+def test_span_buffer_bounded_drop_oldest():
+    buf = tracing.SpanBuffer(capacity=16)
+    for i in range(50):
+        buf.add((1, i, 0, "hop", "r", i, 1, None))
+    assert len(buf) == 16
+    assert buf.dropped == 34
+    # drop-OLDEST: the newest spans survive
+    assert {s["span_id"] for s in buf.snapshot()} == set(range(34, 50))
+
+
+def test_sampling_deterministic():
+    tracing.set_sampling(8)
+    picks = [s for s in range(64) if tracing.sampled(s)]
+    assert picks == [0, 8, 16, 24, 32, 40, 48, 56]
+    tracing.set_sampling(0)
+    assert not any(tracing.sampled(s) for s in range(64))
+    assert not tracing.enabled()
+    # the explicit-n form used by senders
+    assert tracing.sampled(4, 2) and not tracing.sampled(5, 2)
+
+
+def test_make_id_deterministic_and_63bit():
+    a = tracing.make_id(b"cppsim-0*block", 128)
+    assert a == tracing.make_id(b"cppsim-0*block", 128)
+    assert a != tracing.make_id(b"cppsim-0*block", 129)
+    assert 0 < a < (1 << 63)
+
+
+# -- context codec ---------------------------------------------------------
+
+
+def test_context_codec_roundtrip():
+    ctx = tracing.encode_context(123, 456, send_us=789, origin_dur_us=42)
+    dec = tracing.decode_context(ctx)
+    assert (dec.trace_id, dec.span_id, dec.send_us, dec.origin_dur_us) == (
+        123, 456, 789, 42,
+    )
+    assert dec.version == tracing.CTX_VERSION
+
+
+def test_context_codec_unknown_newer_version_tolerated():
+    # a future sender appends fields; this receiver reads its prefix
+    dec = tracing.decode_context([99, 5, 6, 777, 10, "future-field", {"x": 1}])
+    assert dec is not None
+    assert (dec.version, dec.trace_id, dec.span_id, dec.send_us,
+            dec.origin_dur_us) == (99, 5, 6, 777, 10)
+
+
+@pytest.mark.parametrize("junk", [
+    None, b"junk", "junk", 42, {}, [], [1], [1, 2, 3],
+    [0, 1, 2, 3],          # version < 1
+    ["x", 1, 2, 3],        # non-int version
+    [1, "a", "b", "c"],    # non-int fields
+])
+def test_context_codec_junk_decodes_to_none(junk):
+    assert tracing.decode_context(junk) is None
+
+
+def test_context_survives_msgpack_header():
+    from distributed_ba3c_tpu.utils.serialize import unpack_block
+
+    meta = [b"id", 3, 2, {}, tracing.encode_context(9, 8, 7, 6)]
+    frames = pack_block(meta, [np.zeros(2, np.float32)])
+    meta2, _ = unpack_block([bytes(f) for f in frames])
+    dec = tracing.decode_context(meta2[4])
+    assert dec is not None and dec.trace_id == 9 and dec.origin_dur_us == 6
+
+
+# -- clock alignment -------------------------------------------------------
+
+
+def test_clock_offset_min_filter_and_align():
+    t = tracing.Tracer()
+    # first observation includes 5 ms transit; a later, luckier one 1 ms
+    assert t.observe_remote_clock("peer", 1_000, local_us=6_000) == 5_000
+    assert t.observe_remote_clock("peer", 10_000, local_us=11_000) == 1_000
+    # min-filter: a slow observation never degrades the estimate
+    assert t.observe_remote_clock("peer", 20_000, local_us=29_000) == 1_000
+    assert t.clock_offset("peer") == 1_000
+    assert t.align("peer", 2_000) == 3_000
+    # unknown peer: identity (no handshake yet)
+    assert t.align("stranger", 2_000) == 2_000
+
+
+def test_receive_context_synthesizes_origin_and_wire_spans():
+    tracing.set_sampling(1)
+    skew_us = 5_000_000  # remote clock 5 s behind ours
+    send_remote = tracing.now_us() - skew_us
+    ctx = tracing.TraceContext(11, 22, send_remote, origin_dur_us=300)
+    out = tracing.receive_context(ctx, "host-x", "master")
+    assert out is not None
+    trace_id, parent = out
+    assert trace_id == 11
+    spans = {s["name"]: s for s in tracing.tracer().spans.snapshot()}
+    assert set(spans) == {"env_step", "wire"}
+    # the env_step span landed on OUR timeline despite the 5 s skew:
+    # aligned send ~= our receive time, so ts is recent, not 5 s ago
+    assert tracing.now_us() - spans["env_step"]["ts_us"] < 2_000_000
+    assert spans["env_step"]["dur_us"] == 300
+    assert spans["wire"]["parent_id"] == spans["env_step"]["span_id"]
+    assert spans["wire"]["span_id"] == parent
+    # per-hop histograms folded into the role registry
+    assert "hop_wire_s" in telemetry.registry("master").names()
+
+
+def test_trace_ref_hop_chains_parents():
+    ref = tracing.TraceRef(7, 100)
+    r2 = ref.hop("a", "learner")
+    r3 = r2.hop("b", "learner")
+    spans = {s["name"]: s for s in tracing.tracer().spans.snapshot()}
+    assert spans["a"]["parent_id"] == 100
+    assert spans["b"]["parent_id"] == spans["a"]["span_id"]
+    assert r3.trace_id == 7
+
+
+def test_span_context_manager_and_flight_correlation():
+    with tracing.trace_scope(4242):
+        with tracing.span(4242, "collate", "learner") as s:
+            pass
+        telemetry.record("trace_test_event", foo=1)
+    spans = tracing.tracer().spans.snapshot()
+    assert spans and spans[-1]["span_id"] == s.span_id
+    ev = [e for e in telemetry.flight_recorder().snapshot()
+          if e["kind"] == "trace_test_event"][-1]
+    assert ev["trace_id"] == 4242
+    # outside the scope, events are unstamped
+    telemetry.record("trace_test_event2", foo=2)
+    ev2 = [e for e in telemetry.flight_recorder().snapshot()
+           if e["kind"] == "trace_test_event2"][-1]
+    assert "trace_id" not in ev2
+
+
+# -- block-wire e2e: complete causal chain ---------------------------------
+
+
+class _WireFrame:
+    def __init__(self, buf):
+        self.buffer = bytes(buf)
+
+
+class _TraceAwarePredictor:
+    """Duck-typed predictor that honors the trace kwarg like the real
+    scheduler: dispatch/fetch attribution, then the callback."""
+
+    num_actions = 4
+
+    def put_block_task(self, states, cb, shed_callback=None, trace=None):
+        k = len(states)
+        if trace is not None:
+            trace.hop("predict_dispatch", "predictor").hop(
+                "predict_fetch", "predictor"
+            )
+        cb(np.zeros(k, np.int32), np.zeros(k, np.float32),
+           np.zeros(k, np.float32))
+        return True
+
+    def put_task(self, state, cb, shed_callback=None, trace=None):
+        if trace is not None:
+            trace.hop("predict_dispatch", "predictor").hop(
+                "predict_fetch", "predictor"
+            )
+        cb(0, 0.0, 0.0)
+        return True
+
+
+def _send_block_steps(master, ident, n_steps, b=2, h=8, w=8, hist=2):
+    obs = np.zeros((hist, b, h, w), np.uint8)
+    rew, dn = np.zeros(b, np.float32), np.zeros(b, np.uint8)
+    for step in range(n_steps):
+        meta = [ident, step, b]
+        if tracing.enabled() and tracing.sampled(step):
+            meta.append({})  # deltas slot pinned so positions never shift
+            meta.append(tracing.encode_context(
+                tracing.make_id(ident, step),
+                tracing.make_id(ident, step, "origin"),
+                origin_dur_us=150,
+            ))
+        master._on_block_frames(
+            [_WireFrame(f) for f in pack_block(meta, [obs, rew, dn])]
+        )
+
+
+CHAIN = ["env_step", "wire", "master_ingest", "predict", "unroll_flush",
+         "queue_wait", "collate", "ingest", "learner_step"]
+
+
+def test_block_wire_trace_complete_and_causal(tmp_path):
+    from distributed_ba3c_tpu.actors.vtrace_master import VTraceSimulatorMaster
+    from distributed_ba3c_tpu.data.dataflow import RolloutFeed
+
+    tracing.set_sampling(4)
+    m = VTraceSimulatorMaster(
+        f"ipc://{tmp_path}/a", f"ipc://{tmp_path}/b", _TraceAwarePredictor(),
+        unroll_len=3, train_queue=queue.Queue(maxsize=64),
+    )
+    feed = RolloutFeed(m.queue, batch_size=2)
+    try:
+        _send_block_steps(m, b"x*block", 8)
+        feed.start()
+        batch = feed.next_batch(timeout=10)
+        ref = batch.pop("_trace")
+        # the learner side of the chain (what Trainer.run_step does)
+        ref.hop("ingest", "learner").hop("learner_step", "learner")
+        spans = [s for s in tracing.tracer().spans.snapshot()
+                 if s["trace_id"] == ref.trace_id]
+        by_name = {s["name"]: s for s in spans}
+        # COMPLETE: every named hop present, plus the predictor branch
+        for name in CHAIN + ["predict_dispatch", "predict_fetch"]:
+            assert name in by_name, (name, sorted(by_name))
+        # CAUSAL: the main chain is a strict parent chain...
+        for prev, cur in zip(CHAIN, CHAIN[1:]):
+            assert by_name[cur]["parent_id"] == by_name[prev]["span_id"], (
+                prev, cur,
+            )
+        # ...the predictor branch parents onto the master_ingest span
+        # (the backpressure-attribution hop — receive->dispatch time is
+        # a master hop, never predictor latency)...
+        assert by_name["predict_dispatch"]["parent_id"] == (
+            by_name["master_ingest"]["span_id"]
+        )
+        # ...and start times are monotone along the chain
+        ts = [by_name[n]["ts_us"] for n in CHAIN]
+        assert ts == sorted(ts)
+        # roles attribute each hop to its plane
+        assert by_name["predict_fetch"]["role"] == "predictor"
+        assert by_name["unroll_flush"]["role"] == "master"
+        assert by_name["learner_step"]["role"] == "learner"
+    finally:
+        feed.stop()
+        m.close()
+        feed.join(timeout=2)
+
+
+def test_block_wire_untraced_steps_carry_no_context(tmp_path):
+    """Sampling off: headers stay at their pre-tracing length and no spans
+    are buffered — the overhead gate's off arm runs the old wire."""
+    from distributed_ba3c_tpu.actors.vtrace_master import VTraceSimulatorMaster
+
+    m = VTraceSimulatorMaster(
+        f"ipc://{tmp_path}/a", f"ipc://{tmp_path}/b", _TraceAwarePredictor(),
+        unroll_len=3, train_queue=queue.Queue(maxsize=64),
+    )
+    try:
+        _send_block_steps(m, b"x*block", 8)
+        seg = m.queue.get_nowait()
+        assert "_trace" not in seg
+        assert len(tracing.tracer().spans.snapshot()) == 0
+    finally:
+        m.close()
+
+
+def test_ba3c_nstep_flush_carries_trace_rider(tmp_path):
+    from distributed_ba3c_tpu.actors.master import BA3CSimulatorMaster
+    from distributed_ba3c_tpu.data.dataflow import claim_trace
+
+    tracing.set_sampling(4)
+    m = BA3CSimulatorMaster(
+        f"ipc://{tmp_path}/a", f"ipc://{tmp_path}/b", _TraceAwarePredictor(),
+        gamma=0.5, local_time_max=3, train_queue=queue.Queue(maxsize=256),
+    )
+    try:
+        _send_block_steps(m, b"x*block", 6)
+        refs = []
+        while True:
+            try:
+                item = m.queue.get_nowait()
+            except queue.Empty:
+                break
+            ref = claim_trace(item)
+            assert len(item) == 3  # the rider came OFF the datapoint
+            if ref is not None:
+                refs.append(ref)
+        assert len(refs) == 1  # one trace per sampled block, claimed once
+        names = {s["name"] for s in tracing.tracer().spans.snapshot()
+                 if s["trace_id"] == refs[0].trace_id}
+        assert "nstep_flush" in names and "env_step" in names
+    finally:
+        m.close()
+
+
+def test_ba3c_per_env_trace_continues_past_wire(tmp_path):
+    """The per-env wire's BA3C path must chain like the V-trace path:
+    predict + nstep_flush hops and a rider on the emitted datapoint —
+    not a 2-span stub that dies at the wire."""
+    from distributed_ba3c_tpu.actors.master import BA3CSimulatorMaster
+    from distributed_ba3c_tpu.data.dataflow import claim_trace
+
+    tracing.set_sampling(1)
+    m = BA3CSimulatorMaster(
+        f"ipc://{tmp_path}/a", f"ipc://{tmp_path}/b", _TraceAwarePredictor(),
+        gamma=0.5, local_time_max=3, train_queue=queue.Queue(maxsize=256),
+    )
+    try:
+        ident = b"simulator-0"
+        state = np.zeros((8, 8, 4), np.uint8)
+        trace_id = None
+        for step in range(6):
+            # what the receive loop does per sampled message: decode the
+            # context element, park the ref
+            ctx = tracing.encode_context(
+                tracing.make_id(ident, step),
+                tracing.make_id(ident, step, "o"), origin_dur_us=50,
+            )
+            client = m.clients[ident]
+            client.pending_trace = m._recv_trace(ident, ctx)
+            if trace_id is None:
+                trace_id = client.pending_trace.trace_id
+            m._on_message(ident, state, reward=1.0, is_over=False)
+        refs = []
+        while True:
+            try:
+                item = m.queue.get_nowait()
+            except queue.Empty:
+                break
+            ref = claim_trace(item)
+            assert len(item) == 3
+            if ref is not None:
+                refs.append(ref)
+        assert refs, "no rider reached the train queue"
+        names = {s["name"] for s in tracing.tracer().spans.snapshot()
+                 if s["trace_id"] == refs[0].trace_id}
+        assert {"env_step", "wire", "predict", "nstep_flush"} <= names, names
+    finally:
+        m.close()
+
+
+# -- pod e2e: two hosts, one aligned timeline ------------------------------
+
+
+class _StubPodStep:
+    """Device-free pod learner step: real consume() path, no mesh."""
+
+    state_sharding = None
+    block_sharding = None
+
+    def __call__(self, state, block, beta, lr):
+        return state, {"value_lag_mae": 0.0}
+
+
+def test_pod_two_host_trace_clock_aligned(tmp_path):
+    """Two shipping hosts + the real zmq experience channel + the real
+    gate/learner consume path: both hosts' traces must land complete on
+    the LEARNER'S timeline, with a measured clock offset per host."""
+    import zmq
+
+    from distributed_ba3c_tpu.config import BA3CConfig
+    from distributed_ba3c_tpu.pod.ingest import PodIngest
+    from distributed_ba3c_tpu.pod.learner import PodLearner
+    from distributed_ba3c_tpu.pod.wire import PodEndpoints, pack_experience, pod_role
+
+    tracing.set_sampling(1)
+    endpoints = PodEndpoints(
+        params_pub=f"ipc://{tmp_path}/pub",
+        params_fetch=f"ipc://{tmp_path}/fetch",
+        experience=f"ipc://{tmp_path}/exp",
+    )
+    ingest = PodIngest(endpoints, depth=8)
+    learner = PodLearner(
+        _StubPodStep(), {"w": np.zeros(2, np.float32)}, BA3CConfig(),
+        max_staleness=None,
+    )
+    ctx = zmq.Context()
+    try:
+        ingest.start()
+        T, B = 2, 2
+        batch = {
+            "state": np.zeros((T, B, 8, 8, 4), np.uint8),
+            "action": np.zeros((T, B), np.int32),
+            "reward": np.zeros((T, B), np.float32),
+            "done": np.zeros((T, B), np.float32),
+            "behavior_log_probs": np.zeros((T, B), np.float32),
+            "behavior_values": np.zeros((T, B), np.float32),
+            "bootstrap_state": np.zeros((B, 8, 8, 4), np.uint8),
+        }
+        for host in (0, 1):
+            # each "host" ships one traced block, exactly what
+            # ExperienceShipper does after host_collate: context carries
+            # the host's send stamp (the clock handshake)
+            ref = tracing.TraceRef(
+                tracing.make_id("pod", host), tracing.make_id("pod", host, "o")
+            )
+            frames = pack_experience(
+                host, 0, batch, {"env_steps_total": 1.0},
+                trace=tracing.encode_context(ref.trace_id, ref.parent_id),
+            )
+            push = ctx.socket(zmq.PUSH)
+            push.connect(endpoints.experience)
+            push.send_multipart(frames)
+            push.close(1000)
+        got = []
+        deadline = time.monotonic() + 10
+        while len(got) < 2 and time.monotonic() < deadline:
+            sb = ingest.next_batch(timeout=1.0)
+            if sb is not None:
+                got.append(sb)
+        assert len(got) == 2, "both hosts' blocks must arrive"
+        for sb in got:
+            assert sb.trace is not None
+            out = learner.consume(sb)
+            assert out is not None  # gated, staged, stepped
+        doc = tracing.tracer().document()
+        # a measured offset per host peer (the handshake)
+        assert pod_role(0) in doc["clock_offsets_us"]
+        assert pod_role(1) in doc["clock_offsets_us"]
+        for host in (0, 1):
+            spans = [s for s in doc["spans"]
+                     if s["trace_id"] == tracing.make_id("pod", host)]
+            names = [s["name"] for s in spans]
+            for need in ("pod_wire", "staleness_gate", "pod_ingest_stage",
+                         "pod_learner_step"):
+                assert need in names, (host, names)
+            # clock-aligned: every span sits on the learner's recent
+            # monotonic timeline and starts are causally ordered
+            ts = [s["ts_us"] for s in spans]
+            assert ts == sorted(ts)
+            assert all(tracing.now_us() - t < 60_000_000 for t in ts)
+    finally:
+        ingest.close()
+        ctx.term()
+
+
+def test_pod_params_publish_trace_reaches_cache(tmp_path):
+    """The params leg: a sampled publish's context survives the params
+    codec and produces the cache-side apply span + learner clock offset."""
+    from distributed_ba3c_tpu.pod.cache import StaleParamsCache
+    from distributed_ba3c_tpu.pod.wire import PodEndpoints, pack_params
+
+    tracing.set_sampling(1)
+    endpoints = PodEndpoints(
+        params_pub=f"ipc://{tmp_path}/pub2",
+        params_fetch=f"ipc://{tmp_path}/fetch2",
+        experience=f"ipc://{tmp_path}/exp2",
+    )
+    cache = StaleParamsCache(endpoints, host=0)
+    try:
+        payload = pack_params(
+            3, {"w": np.ones(2, np.float32)}, step=7, epoch=5,
+            trace=tracing.encode_context(777, 888),
+        )
+        assert cache._apply_safe(payload)
+        assert cache.version == 3
+        spans = [s for s in tracing.tracer().spans.snapshot()
+                 if s["trace_id"] == 777]
+        names = {s["name"] for s in spans}
+        assert "params_wire" in names and "params_apply" in names
+        assert tracing.tracer().clock_offset("pod-learner") is not None
+    finally:
+        cache.close()
+
+
+def test_epoch_mismatch_rejection_ends_trace_visibly():
+    """The OTHER rejection path keeps the same contract: a block from a
+    foreign publisher lifetime ends its trace with a verdict span."""
+    from distributed_ba3c_tpu.config import BA3CConfig
+    from distributed_ba3c_tpu.pod.ingest import StampedBatch
+    from distributed_ba3c_tpu.pod.learner import PodLearner
+
+    class _Pub:
+        epoch = 7
+
+        def publish(self, *a, **k):
+            pass
+
+    tracing.set_sampling(1)
+    learner = PodLearner(
+        _StubPodStep(), {"w": np.zeros(2, np.float32)}, BA3CConfig(),
+    )
+    # attach post-init (the init-time version-0 publish needs a real
+    # TrainState; the epoch check only reads publisher.epoch)
+    learner.publisher = _Pub()
+    ref = tracing.TraceRef(66, 1)
+    out = learner.consume(
+        StampedBatch(host=0, version=0, batch={}, epoch=99, trace=ref)
+    )
+    assert out is None
+    spans = [s for s in tracing.tracer().spans.snapshot()
+             if s["trace_id"] == 66]
+    assert [s["name"] for s in spans] == ["epoch_gate"]
+    assert spans[0]["tags"] == {"rejected": True, "reason": "epoch_mismatch"}
+
+
+def test_staleness_gate_rejection_ends_trace_visibly():
+    from distributed_ba3c_tpu.config import BA3CConfig
+    from distributed_ba3c_tpu.pod.ingest import StampedBatch
+    from distributed_ba3c_tpu.pod.learner import PodLearner
+
+    tracing.set_sampling(1)
+    learner = PodLearner(
+        _StubPodStep(), {"w": np.zeros(2, np.float32)}, BA3CConfig(),
+        max_staleness=1,
+    )
+    learner.version = 10
+    ref = tracing.TraceRef(55, 1)
+    out = learner.consume(
+        StampedBatch(host=0, version=2, batch={}, epoch=0, trace=ref)
+    )
+    assert out is None  # rejected — and the trace says so
+    spans = [s for s in tracing.tracer().spans.snapshot()
+             if s["trace_id"] == 55]
+    assert [s["name"] for s in spans] == ["staleness_gate"]
+    assert spans[0]["tags"]["rejected"] is True
+
+
+# -- endpoints + dump smoke ------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def test_trace_endpoint_and_flight_filters():
+    tracing.set_sampling(1)
+    ref = tracing.TraceRef(99, 1)
+    ref.hop("wire", "master")
+    t_mid = time.monotonic()
+    telemetry.record("prune", ident="x")
+    telemetry.record("queue_wait", wait_s=0.1)
+    srv = telemetry.TelemetryServer(port=0, host="127.0.0.1")
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        doc = _get(f"{base}/trace")
+        assert doc["sample_n"] == 1
+        assert any(s["trace_id"] == 99 for s in doc["spans"])
+        assert {"anchor_monotonic_us", "anchor_wall",
+                "clock_offsets_us"} <= set(doc)
+        # the filtered flight endpoint: kind alone, since alone, both
+        kinds = {e["kind"] for e in _get(f"{base}/flight?kind=prune")}
+        assert kinds == {"prune"}
+        since = _get(f"{base}/flight?since={t_mid}")
+        assert {e["kind"] for e in since} == {"prune", "queue_wait"}
+        both = _get(f"{base}/flight?since={t_mid}&kind=queue_wait")
+        assert [e["kind"] for e in both] == ["queue_wait"]
+        # junk params must not error the scrape
+        assert isinstance(_get(f"{base}/flight?since=junk"), list)
+        # the unfiltered ring still works
+        assert len(_get(f"{base}/flight")) >= 2
+    finally:
+        srv.stop()
+        srv.join(timeout=2)
+        srv.close()
+
+
+def test_trace_dump_merges_and_validates(tmp_path):
+    """Two process documents (one with a wall-anchor skew) merge onto one
+    timeline; the emitted JSON passes the CI schema validation."""
+    tracing.set_sampling(1)
+    ref = tracing.TraceRef(1234, 1)
+    ref.hop("wire", "master").hop("predict", "master")
+    doc_a = tracing.tracer().document()
+    # a second "process": same spans, anchors shifted as if its monotonic
+    # clock started 1000 s later but wall time agrees
+    doc_b = json.loads(json.dumps(doc_a))
+    # SAME os pid on purpose (two containers both pid 1): the merge must
+    # keep the documents' tracks and alignment entries distinct
+    shift = 1_000_000_000
+    for s in doc_b["spans"]:
+        s["ts_us"] += shift
+    doc_b["anchor_monotonic_us"] += shift
+    pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+    pa.write_text(json.dumps(doc_a))
+    pb.write_text(json.dumps(doc_b))
+    out = tmp_path / "chrome.json"
+    r = subprocess.run(
+        [sys.executable, "scripts/trace_dump.py", str(pa), str(pb),
+         "-o", str(out), "--validate"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(out.read_text())
+    # per-document alignment survives even with colliding OS pids
+    assert set(doc["metadata"]["alignment"]) == {"doc0", "doc1"}
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(events) == 4  # 2 spans x 2 processes
+    assert {e["pid"] for e in events} == {0, 1}  # distinct tracks
+    # the two processes' copies of the same span landed within ~1 s of
+    # each other on the merged timeline (wall-anchor alignment), not
+    # 1000 s apart (raw monotonic)
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e["ts"])
+    for name, ts in by_name.items():
+        assert abs(ts[0] - ts[1]) < 2_000_000, (name, ts)
+    # embedded-trace form (plane_bench --trace JSONs) loads too
+    bench_like = tmp_path / "bench.json"
+    bench_like.write_text(json.dumps({"metric": "x", "trace": doc_a}))
+    r2 = subprocess.run(
+        [sys.executable, "scripts/trace_dump.py", str(bench_like),
+         "--validate"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert r2.returncode == 0, r2.stderr
+
+
+def test_trace_disabled_paths_are_inert(tmp_path):
+    """BA3C_TELEMETRY=0 semantics: with telemetry disabled, tracing
+    reports disabled, the RECEIVE side refuses remotely-stamped
+    contexts, and the span sink drops writes — the kill switch covers
+    the whole plane, not just the sender."""
+    tracing.set_sampling(16)
+    telemetry.set_enabled(False)
+    try:
+        assert not tracing.enabled()
+        # a remote sender's sampled context must not fill this process's
+        # buffer when its telemetry is killed
+        ctx = tracing.TraceContext(1, 2, tracing.now_us(), 100)
+        assert tracing.receive_context(ctx, "peer", "master") is None
+        tracing.TraceRef(1, 2).hop("wire", "master")
+        assert len(tracing.tracer().spans.snapshot()) == 0
+    finally:
+        telemetry.set_enabled(True)
+    assert tracing.enabled()

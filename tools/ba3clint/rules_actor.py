@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import ast
 import os
-from typing import Dict, Iterator, List, Set
+from typing import Dict, Iterator, List, Optional, Set
 
 from tools.ba3clint.engine import (
     FileContext,
@@ -20,6 +20,7 @@ from tools.ba3clint.engine import (
     dotted_name,
     enclosing_functions,
     enclosing_statement,
+    parent,
 )
 
 _THREAD_CTORS = {"threading.Thread"}
@@ -798,6 +799,176 @@ class UnversionedParamsReadRule(Rule):
                     )
 
 
+#: span-constructor call names (telemetry/tracing.py): the class used as
+#: `with tracing.span(...)` or explicitly `.finish()`ed
+_SPAN_CTOR_SUFFIXES = ("tracing.span",)
+
+#: metric-name tokens that mark a monotonic subtraction as latency math
+#: (A7's token set plus the trace plane's own vocabulary)
+_LATENCY_TOKENS = _METRIC_NAME_TOKENS | {"hop", "e2e"}
+
+#: consuming attributes that make a monotonic pair SANCTIONED in place:
+#: the value flows straight into the telemetry plane
+_TELEMETRY_SINKS = {"observe", "record", "hop", "finish_span", "set"}
+
+
+class OrphanSpanRule(Rule):
+    """A11: a span started outside a context manager / without finish(),
+    or ad-hoc ``time.monotonic()`` pair latency math outside ``telemetry/``.
+
+    The trace plane (telemetry/tracing.py, docs/observability.md) only
+    attributes wall-clock that actually reaches the span buffer: a
+    ``tracing.span(...)`` constructed bare — not as a ``with`` item, not
+    ``finish()``ed on every exit path — buffers NOTHING (its duration
+    silently never lands, and the per-hop ``hop_<name>_s`` histogram the
+    exporters serve stays empty), which is strictly worse than no
+    instrumentation because the call site LOOKS covered. And a
+    hand-rolled ``latency = time.monotonic() - t0`` that feeds a print or
+    a local is A7's ad-hoc-metric hazard with the monotonic clock — right
+    clock, wrong sink: route it through a Histogram ``observe`` or a span
+    hop so every exporter sees it. Monotonic pairs flowing directly into
+    ``.observe(...)``/``.hop(...)``/``record(...)`` in the same statement
+    are the sanctioned shape; ``telemetry/`` itself is exempt (something
+    has to implement the plane).
+    """
+
+    id = "A11"
+    name = "orphan-span"
+    summary = "span without context-manager/finish(), or ad-hoc monotonic-pair latency math"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        in_telemetry = (
+            "telemetry" in ctx.path.replace(os.sep, "/").split("/")
+        )
+        if not in_telemetry:
+            yield from self._check_monotonic_pairs(ctx)
+        yield from self._check_orphan_spans(ctx)
+
+    # -- half 1: tracing.span(...) lifecycle -------------------------------
+    def _check_orphan_spans(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.info.resolve(node.func)
+            if not resolved or not (
+                resolved.endswith(_SPAN_CTOR_SUFFIXES) or resolved == "span"
+            ):
+                continue
+            if self._is_with_item(node):
+                continue
+            stmt = enclosing_statement(node)
+            var = self._assigned_name(stmt, node)
+            if var is not None and self._finished_in_scope(node, var):
+                continue
+            yield ctx.finding(
+                self, node,
+                "span constructed outside a `with` and never .finish()ed "
+                "on this path — its duration never reaches the span "
+                "buffer or the hop_<name>_s histogram; use `with "
+                "tracing.span(...) as s:` or finish() on every exit "
+                "(telemetry/tracing.py)",
+            )
+
+    @staticmethod
+    def _is_with_item(call: ast.Call) -> bool:
+        p = parent(call)
+        return isinstance(p, ast.withitem) and p.context_expr is call
+
+    @staticmethod
+    def _assigned_name(stmt, call) -> "str | None":
+        if isinstance(stmt, ast.Assign) and stmt.value is call:
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    return t.id
+        return None
+
+    @staticmethod
+    def _finished_in_scope(node: ast.AST, var: str) -> bool:
+        scope: ast.AST = node
+        for cur in ancestors(node):
+            scope = cur
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+        for sub in ast.walk(scope):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "finish"
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id == var
+            ):
+                return True
+        return False
+
+    # -- half 2: monotonic pair latency math -------------------------------
+    def _check_monotonic_pairs(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.info.resolve(node.func) != "time.monotonic":
+                continue
+            sub = self._enclosing_subtraction(node)
+            if sub is None:
+                continue
+            if self._feeds_telemetry_sink(sub):
+                continue
+            stmt = enclosing_statement(node)
+            if stmt is None or not self._stmt_mentions_latency(stmt):
+                continue
+            yield ctx.finding(
+                self, node,
+                "time.monotonic() pair latency math outside telemetry/ — "
+                "feed the duration to a Histogram .observe() or a span "
+                "hop in the same statement so the scrape endpoint / "
+                "stat.json / trace plane all see it (A7's intent, "
+                "monotonic edition)",
+            )
+
+    @staticmethod
+    def _enclosing_subtraction(node: ast.AST) -> Optional[ast.BinOp]:
+        for cur in ancestors(node):
+            if isinstance(cur, ast.BinOp) and isinstance(cur.op, ast.Sub):
+                return cur
+            if isinstance(cur, ast.stmt):
+                return None
+        return None
+
+    @staticmethod
+    def _feeds_telemetry_sink(sub: ast.BinOp) -> bool:
+        # the subtraction is an ARGUMENT of an .observe()/.hop()/record()
+        # call in the same expression — the sanctioned in-place shape
+        for cur in ancestors(sub):
+            if isinstance(cur, ast.Call):
+                fn = cur.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None
+                )
+                if name in _TELEMETRY_SINKS:
+                    return True
+            if isinstance(cur, ast.stmt):
+                return False
+        return False
+
+    @staticmethod
+    def _stmt_mentions_latency(stmt: ast.stmt) -> bool:
+        for sub in ast.walk(stmt):
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            if not name:
+                continue
+            low = name.lower()
+            if low in _NON_METRIC_NAMES:
+                continue
+            if not _LATENCY_TOKENS.isdisjoint(low.split("_")):
+                return True
+            if "persec" in low.replace("_", ""):
+                return True
+        return False
+
+
 ACTOR_RULES = [
     BareThreadRule(),
     BlockingQueueOpRule(),
@@ -809,4 +980,5 @@ ACTOR_RULES = [
     UnsupervisedFleetSpawnRule(),
     ServingHotPathBlockRule(),
     UnversionedParamsReadRule(),
+    OrphanSpanRule(),
 ]
