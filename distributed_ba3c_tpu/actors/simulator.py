@@ -37,7 +37,12 @@ from distributed_ba3c_tpu.utils.concurrency import (
     StoppableThread,
     queue_put_stoppable,
 )
-from distributed_ba3c_tpu.utils.serialize import dumps, loads, unpack_block
+from distributed_ba3c_tpu.utils.serialize import (
+    CorruptFrameError,
+    dumps,
+    loads,
+    unpack_block,
+)
 
 
 class TransitionExperience:
@@ -379,6 +384,11 @@ class SimulatorMaster(threading.Thread):
         self._c_pruned = tele.counter("clients_pruned_total")
         self._c_dropped = tele.counter("clients_dropped_total")
         self._c_rejected = tele.counter("blocks_rejected_total")
+        # integrity rejects get their OWN typed counter next to the
+        # structural one: a CRC mismatch means bytes changed in flight
+        # (netchaos corruption, a flaky NIC), not a version-skewed sender —
+        # the operator runbook branches on exactly this distinction
+        self._c_corrupt = tele.counter("corrupt_frames_total")
         self._c_incarnation = tele.counter("incarnation_resets_total")
         self._c_blocked_puts = tele.counter("queue_blocked_puts_total")
         self._h_put_wait = tele.histogram("queue_put_wait_s", unit=1e-6)
@@ -441,7 +451,10 @@ class SimulatorMaster(threading.Thread):
                 if msg is None:
                     return
                 try:
-                    self.s2c_socket.send_multipart(msg)
+                    # ROUTER sends never block: an unroutable ident or a
+                    # peer past its HWM DROPS the message (MANDATORY off)
+                    # — bounded by construction, not by timeout
+                    self.s2c_socket.send_multipart(msg)  # ba3clint: disable=A12 — ROUTER drops, never parks
                 except zmq.ZMQError:
                     if t.stopped() or self._stop_evt.is_set():
                         return  # socket closed during teardown
@@ -475,8 +488,34 @@ class SimulatorMaster(threading.Thread):
                 # back the numpy views directly (zero-copy ingest).
                 frames = self.c2s_socket.recv_multipart(copy=False)
                 if len(frames) == 1:
-                    msg = loads(frames[0].buffer)
-                    ident, state, reward, is_over = msg[:4]
+                    try:
+                        msg = loads(frames[0].buffer)
+                        ident, state, reward, is_over = msg[:4]
+                    except CorruptFrameError as e:
+                        # typed integrity reject: the frame's CRC failed —
+                        # count it, record it, keep the loop alive (the
+                        # lockstep sender re-sends nothing, parks in recv,
+                        # and is pruned/respawned like any dead actor)
+                        self._c_corrupt.inc()
+                        self._flight.record(
+                            "corrupt_frame", wire="per-env",
+                            error=str(e)[:200],
+                        )
+                        logger.error("dropping corrupt per-env frame: %s", e)
+                        continue
+                    except Exception as e:
+                        # untrusted wire input (msgpack raises its own
+                        # hierarchy): a malformed per-env frame must not
+                        # kill the receive loop for every healthy client —
+                        # same posture as the block decoder below
+                        self._c_rejected.inc()
+                        self._flight.record(
+                            "per_env_reject", error=str(e)[:200]
+                        )
+                        logger.error(
+                            "dropping undecodable per-env message: %s", e
+                        )
+                        continue
                     if len(msg) > 4:
                         # length-versioned header: element 5 is the sender's
                         # piggybacked metric deltas (telemetry/wire.py);
@@ -652,6 +691,17 @@ class SimulatorMaster(threading.Thread):
                 meta[base_meta_len + 1]
                 if len(meta) > base_meta_len + 1 else None
             )
+        except CorruptFrameError as e:
+            # typed integrity reject (CRC mismatch — bytes changed in
+            # flight): its own counter + flight kind so operators can tell
+            # link corruption from sender version skew; never reaches a
+            # frombuffer view (serialize.unpack_block verifies first)
+            self._c_corrupt.inc()
+            self._flight.record(
+                "corrupt_frame", wire="block", error=str(e)[:200]
+            )
+            logger.error("dropping corrupt block frame: %s", e)
+            return
         except (ValueError, TypeError, IndexError) as e:
             # wire input is untrusted: a version-mismatched fleet (or any
             # stray sender on the bound port) must not kill the receive

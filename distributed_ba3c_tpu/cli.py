@@ -59,6 +59,17 @@ def make_parser() -> argparse.ArgumentParser:
         "mixed fleets work; per-process simulators (fake/gym:/jax:) always "
         "speak per-env",
     )
+    p.add_argument(
+        "--wire_crc",
+        action="store_true",
+        help="CRC32 integrity framing on every wire codec (block, "
+        "block-shm control, per-env, pod params/experience): a corrupted "
+        "or truncated frame becomes a typed corrupt_frame reject at the "
+        "receiver instead of a silently wrong array. Exported as "
+        "BA3C_WIRE_CRC=1 so spawned env servers / pod hosts agree "
+        "(docs/netchaos.md); worth ~one memory pass per message — "
+        "recommended for any real-DCN fleet, off by default on loopback",
+    )
     p.add_argument("--load", default=None, help="checkpoint dir to resume from")
     p.add_argument("--logdir", default="train_log/ba3c")
     # -- hyperparams (reference argparse defaults, SURVEY.md §2.9) ---------
@@ -455,6 +466,14 @@ def main(argv: Optional[list] = None) -> int:
         # inheritance idiom (telemetry/tracing.py)
         telemetry.tracing.set_sampling(args.trace_sample)
         os.environ["BA3C_TRACE"] = str(args.trace_sample)
+    if args.wire_crc:
+        # arm CRC framing here AND in the env var: spawned env servers and
+        # pod hosts read BA3C_WIRE_CRC at import — a fleet where only one
+        # side frames would reject nothing and verify nothing
+        from distributed_ba3c_tpu.utils.serialize import set_wire_crc
+
+        set_wire_crc(True)
+        os.environ["BA3C_WIRE_CRC"] = "1"
     if args.task == "train":
         telemetry.install_signal_dump()
 

@@ -360,11 +360,15 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
                 ring.arr[step % cap] = obs
                 meta = [ident, step, B, ring_name, cap, H, W, hist]
                 extend_meta(meta, step, env_us)  # length-versioned tail
-                push.send_multipart(
+                # lockstep protocol: parking in send/recv awaiting the
+                # action reply IS the env server's contract — a dead
+                # master leaves this process to its supervisor (prune +
+                # respawn), never to a local timeout
+                push.send_multipart(  # ba3clint: disable=A12 — lockstep park, supervisor-owned lifetime
                     pack_block(meta, [rewards, dones]),
                     copy=False,
                 )
-                actions = np.frombuffer(dealer.recv(), np.int32)
+                actions = np.frombuffer(dealer.recv(), np.int32)  # ba3clint: disable=A12 — lockstep park
                 t_env = tracing.now_us() if tracing.enabled() else 0
                 obs, rew, dn = env.step(actions)
                 if t_env:
@@ -419,11 +423,11 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
                 # with actions before it has received (= fully copied out of
                 # this process over ipc/tcp) the observation message, and we
                 # do not mutate the buffers until that reply arrives.
-                push.send_multipart(
+                push.send_multipart(  # ba3clint: disable=A12 — lockstep park, supervisor-owned lifetime
                     pack_block(meta, [stacks, rewards, dones]),
                     copy=False,
                 )
-                actions = np.frombuffer(dealer.recv(), np.int32)
+                actions = np.frombuffer(dealer.recv(), np.int32)  # ba3clint: disable=A12 — lockstep park
                 t_env = tracing.now_us() if tracing.enabled() else 0
                 obs, rew, dn = env.step(actions)
                 if t_env:
@@ -485,11 +489,11 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
                     msg = [idents[i], stacks[i], float(rewards[i]), bool(dones[i])]
                     if i == 0 and tele is not None:
                         msg.append(tele)
-                    push.send(  # ba3clint: disable=A6 — compat foil, see docstring
+                    push.send(  # ba3clint: disable=A6,A12 — compat foil (lockstep park), see docstring
                         dumps(msg)
                     )
                 for i in range(B):
-                    actions[i] = loads(dealers[i].recv())  # ba3clint: disable=A6 — compat foil
+                    actions[i] = loads(dealers[i].recv())  # ba3clint: disable=A6,A12 — compat foil (lockstep park)
                 obs, rew, dn = env.step(actions)
                 rewards[:] = rew
                 dones[:] = dn.astype(bool)

@@ -1,0 +1,50 @@
+"""Netchaos: a deterministic network fault-injection plane (docs/netchaos.md).
+
+Everything the repo had proven about failure before this package was
+process death on loopback wires that never delay, drop or partition
+(ROADMAP item 2's named debt). Netchaos closes it: seeded, per-link
+fault schedules (:mod:`schedule`) applied by in-process ZMQ proxy pumps
+(:mod:`proxy`) interposed on any link the fleet/pod port map derives
+(:mod:`plane` — hand a process a proxied base pipe pair and every
+derived channel routes through the injector unchanged). Latency (fixed +
+jitter), probabilistic drop, bandwidth caps, reorder, frame
+truncation/corruption, and timed full/asymmetric partitions; every
+injected event is flight-recorded with the schedule seed and the whole
+event log is re-derivable from that seed (``NetChaosPlane.replay_check``)
+— a failing rep replays exactly.
+
+The hardening it forced lives in the transports themselves: CRC32 wire
+framing with typed ``corrupt_frame`` rejects (utils/serialize.py),
+heartbeat-driven per-link ``up -> degraded -> partitioned`` state
+machines (pod/linkstate.py) on the params cache/publisher and the
+experience shipper, bounded reconnect/backoff with the epoch-stamp
+rejoin contract, and degraded-mode semantics: a params-partitioned host
+sheds through the staleness gate, a shipper against a partitioned ingest
+spills to a bounded drop-oldest buffer — rollout never wedges.
+
+Gates: ``scripts/chaos_bench.py --net`` (throughput under 50 ms RTT + 1%
+loss >= 0.85x clean; partition-and-heal with zero learner restarts) and
+``scripts/pod_bench.py --net`` (the emulated-DCN rows,
+``runs/netchaos_bench_r14.json``).
+"""
+
+from __future__ import annotations
+
+from distributed_ba3c_tpu.netchaos.schedule import (  # noqa: F401
+    DIRECTIONS,
+    RNG_KINDS,
+    Decision,
+    FaultSchedule,
+    LinkFaults,
+    Partition,
+)
+from distributed_ba3c_tpu.netchaos.proxy import (  # noqa: F401
+    LinkProxy,
+    PubProxy,
+    PushPullProxy,
+    RouterProxy,
+)
+from distributed_ba3c_tpu.netchaos.plane import (  # noqa: F401
+    MASK_KINDS,
+    NetChaosPlane,
+)

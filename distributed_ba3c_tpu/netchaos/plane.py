@@ -1,0 +1,364 @@
+"""NetChaosPlane: schedule-driven interposition on the fleet/pod port map.
+
+One plane owns one :class:`FaultSchedule` and a set of proxy pumps
+(netchaos/proxy.py) standing between real endpoints and the processes
+that would have connected to them. Addressing is the whole trick
+(docs/netchaos.md): the repo's transports derive every channel from a
+base pipe pair — ``fleet_pipes`` for the actor plane, ``pod_endpoints``
+(+100..+102) for the pod — so handing a process a *proxied base pair*
+re-routes every derived channel through the injector with ZERO changes
+to the process under test. :meth:`wrap_pod` and :meth:`wrap_fleet` are
+exactly that derivation, proxied.
+
+Every injected event lands three ways:
+
+- the plane's own bounded event log — ``(t_rel, link, dir, seq, kind)``
+  — the replay source of truth the bench artifacts embed;
+- ``netchaos_<kind>_total`` counters on the ``netchaos`` registry (the
+  scrape endpoint shows injection live);
+- the flight recorder (kind ``netchaos_inject``, stamped with the
+  schedule seed), so a postmortem dump of a failing rep names the exact
+  faults in flight around the failure.
+
+:meth:`replay_check` is the determinism gate: it re-derives, from the
+seed alone, the discrete-fault decision for every message sequence the
+run carried and diffs it against the recorded log — byte-for-byte equal
+or the rep is not replayable and the bench fails.
+"""
+
+from __future__ import annotations
+
+import socket as _socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import zmq
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.netchaos.proxy import (
+    LinkProxy,
+    PubProxy,
+    PushPullProxy,
+    RouterProxy,
+)
+from distributed_ba3c_tpu.netchaos.schedule import (
+    RNG_KINDS,
+    FaultSchedule,
+)
+from distributed_ba3c_tpu.pod.wire import POD_PORT_OFFSET, pod_endpoints
+from distributed_ba3c_tpu.utils import logger
+from distributed_ba3c_tpu.utils.serialize import loads
+
+#: event kinds that are NOT replayable from the RNG alone: partition
+#: window entry/exit is time-driven (seq -1, the link simply stops
+#: draining) and overflow is the receiving socket's backpressure, not
+#: the schedule's decision
+MASK_KINDS = ("partition_start", "partition_heal", "overflow")
+
+
+def _sniff_ident(frames: List[bytes]) -> Optional[bytes]:
+    """Best-effort sender-ident extraction from a c2s message (both wire
+    layouts put it first: per-env ``[ident, ...]`` payloads, block header
+    ``meta[0]``). Junk in, None out — the sniffer must never kill a pump."""
+    try:
+        decoded = loads(frames[0])
+        ident = decoded[0][0] if len(frames) > 1 else decoded[0]
+        if isinstance(ident, (bytes, bytearray, memoryview)):
+            return bytes(ident)
+        return str(ident).encode()
+    except Exception:
+        return None
+
+
+def _tcp_parts(addr: str) -> Optional[Tuple[str, int]]:
+    if not addr.startswith("tcp://"):
+        return None
+    host, _, port = addr[len("tcp://"):].rpartition(":")
+    return host, int(port)
+
+
+def _port_block_free(host: str, ports: List[int]) -> bool:
+    for p in ports:
+        s = _socket.socket()
+        try:
+            s.bind((host if host not in ("*",) else "127.0.0.1", p))
+        except OSError:
+            return False
+        finally:
+            s.close()
+    return True
+
+
+def _alloc_base(host: str, offsets: List[int], tries: int = 16) -> int:
+    """A base port such that base+offset is free for every offset."""
+    for _ in range(tries):
+        s = _socket.socket()
+        s.bind((host if host != "*" else "127.0.0.1", 0))
+        base = s.getsockname()[1]
+        s.close()
+        if _port_block_free(host, [base + o for o in offsets if o != 0]):
+            return base
+    raise RuntimeError(
+        f"could not find a free port block for offsets {offsets}"
+    )
+
+
+class NetChaosPlane:
+    """Owns the proxies, the event log, and the replay contract."""
+
+    def __init__(
+        self,
+        schedule: FaultSchedule,
+        max_events: int = 200_000,
+        push_pull_front_hwm: int = 64,
+        arm_on_start: bool = True,
+    ):
+        """``arm_on_start=False`` keeps TIMED faults (partition windows)
+        dormant until :meth:`rebase_clock` — a rig whose warmup length is
+        unknowable (per-host jax imports) must not have the window fire a
+        first time mid-boot and then replay after the rebase. Per-message
+        faults (seq-keyed) are always live."""
+        if isinstance(schedule, str):
+            schedule = FaultSchedule.from_json(schedule)
+        elif isinstance(schedule, dict):
+            schedule = FaultSchedule(
+                schedule.get("links", {}), seed=schedule.get("seed", 0)
+            )
+        self.schedule: FaultSchedule = schedule
+        self.push_pull_front_hwm = int(push_pull_front_hwm)
+        self.context = zmq.Context()
+        self.proxies: List[LinkProxy] = []
+        self._events: List[tuple] = []
+        self._events_dropped = 0
+        self._max_events = int(max_events)
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._armed = bool(arm_on_start)
+        self._started = False
+        tele = telemetry.registry("netchaos")
+        self._counters = {
+            k: tele.counter(f"netchaos_{k}_total")
+            for k in RNG_KINDS + MASK_KINDS
+        }
+
+    # -- time + event accounting -------------------------------------------
+    def t_rel(self) -> float:
+        if not self._armed:
+            # dormant clock: no partition window covers a negative time,
+            # so timed faults stay off until the rebase arms them
+            return -1.0
+        return time.monotonic() - self._t0
+
+    def rebase_clock(self) -> None:
+        """(Arm and) re-zero the schedule clock. Partition windows are
+        relative to it; a bench whose warmup length is unknowable
+        (per-host jax imports) rebases right before its measurement
+        window so a ``[2s, 6s)`` partition means exactly that."""
+        self._armed = True
+        self._t0 = time.monotonic()
+
+    def event(self, link: str, direction: str, seq: int, kind: str) -> None:
+        t = round(self.t_rel(), 4)
+        with self._lock:
+            if len(self._events) < self._max_events:
+                self._events.append((t, link, direction, seq, kind))
+            else:
+                self._events_dropped += 1
+        c = self._counters.get(kind)
+        if c is not None:
+            c.inc()
+        telemetry.record(
+            "netchaos_inject",
+            link=link, dir=direction, seq=seq, fault=kind,
+            seed=self.schedule.seed, t_rel=t,
+        )
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            evs = list(self._events)
+        return [
+            {"t": t, "link": l, "dir": d, "seq": s, "kind": k}
+            for t, l, d, s, k in evs
+        ]
+
+    def summary(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self.events():
+            out[e["kind"]] = out.get(e["kind"], 0) + 1
+        if self._events_dropped:
+            out["log_dropped"] = self._events_dropped
+        return out
+
+    # -- proxy construction -------------------------------------------------
+    def _front_for(self, back_addr: str, suffix: str) -> str:
+        parts = _tcp_parts(back_addr)
+        if parts is None:
+            return f"{back_addr}-nc{suffix}"
+        host, _ = parts
+        base = _alloc_base(host, [0])
+        return f"tcp://{host}:{base}"
+
+    def add_push_pull(
+        self, link: str, back_addr: str, front_addr: Optional[str] = None,
+        on_message=None,
+    ) -> str:
+        front_addr = front_addr or self._front_for(back_addr, f"-{link}")
+        self.proxies.append(
+            PushPullProxy(
+                link, self.schedule, self, front_addr, back_addr,
+                self.context, on_message=on_message,
+                front_hwm=self.push_pull_front_hwm,
+            )
+        )
+        return front_addr
+
+    def add_pub(
+        self, link: str, back_addr: str, front_addr: Optional[str] = None
+    ) -> str:
+        front_addr = front_addr or self._front_for(back_addr, f"-{link}")
+        self.proxies.append(
+            PubProxy(
+                link, self.schedule, self, front_addr, back_addr, self.context
+            )
+        )
+        return front_addr
+
+    def add_router(
+        self, link: str, back_addr: str, front_addr: Optional[str] = None
+    ) -> RouterProxy:
+        front_addr = front_addr or self._front_for(back_addr, f"-{link}")
+        proxy = RouterProxy(
+            link, self.schedule, self, front_addr, back_addr, self.context
+        )
+        self.proxies.append(proxy)
+        return proxy
+
+    # -- port-map wrapping (THE addressing trick) ---------------------------
+    def wrap_pod(self, pipe_c2s: str, pipe_s2c: str) -> Tuple[str, str]:
+        """Proxy every pod channel of a learner at ``(pipe_c2s, pipe_s2c)``.
+
+        Returns a *front base pair*: hand it to actor hosts as their
+        ``--learner_c2s/--learner_s2c`` and their own ``pod_endpoints``
+        derivation (+100..+102) lands exactly on the proxy fronts —
+        ``params_pub``, ``params_fetch`` and ``experience`` each become a
+        schedulable link, the host process unchanged."""
+        real = pod_endpoints(pipe_c2s, pipe_s2c)
+        parts = _tcp_parts(pipe_c2s)
+        if parts is not None:
+            host, _ = parts
+            off = POD_PORT_OFFSET
+            base = _alloc_base(host, [off, off + 1, off + 2])
+            front_c2s = f"tcp://{host}:{base}"
+            front_s2c = f"tcp://{host}:{base + 1}"
+        else:
+            front_c2s = f"{pipe_c2s}-nc"
+            front_s2c = f"{pipe_s2c}-nc"
+        fronts = pod_endpoints(front_c2s, front_s2c)
+        self.add_pub("params_pub", real.params_pub, fronts.params_pub)
+        self.add_router("params_fetch", real.params_fetch, fronts.params_fetch)
+        self.add_push_pull("experience", real.experience, fronts.experience)
+        logger.info(
+            "netchaos wraps pod: %s -> %s (seed %d)",
+            front_c2s, pipe_c2s, self.schedule.seed,
+        )
+        return front_c2s, front_s2c
+
+    def wrap_fleet(self, pipe_c2s: str, pipe_s2c: str) -> Tuple[str, str]:
+        """Proxy a master's experience/action pipe pair: env servers get
+        the returned front pair; ``c2s`` and ``s2c`` become schedulable
+        links. The s2c ROUTER proxy learns client identities from the c2s
+        traffic (clients never speak on s2c), so ident-routed action
+        replies keep routing through the interposition."""
+        parts = _tcp_parts(pipe_c2s)
+        if parts is not None:
+            host, _ = parts
+            base = _alloc_base(host, [0, 1])
+            front_c2s = f"tcp://{host}:{base}"
+            front_s2c = f"tcp://{host}:{base + 1}"
+        else:
+            front_c2s = f"{pipe_c2s}-nc"
+            front_s2c = f"{pipe_s2c}-nc"
+        s2c_proxy = self.add_router("s2c", pipe_s2c, front_s2c)
+
+        def sniff(frames: List[bytes]) -> None:
+            ident = _sniff_ident(frames)
+            if ident is not None:
+                s2c_proxy.ensure_ident(ident)
+
+        self.add_push_pull("c2s", pipe_c2s, front_c2s, on_message=sniff)
+        logger.info(
+            "netchaos wraps fleet: %s -> %s (seed %d)",
+            front_c2s, pipe_c2s, self.schedule.seed,
+        )
+        return front_c2s, front_s2c
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self._t0 = time.monotonic()
+        for p in self.proxies:
+            if not p.is_alive():
+                p.start()
+        self._started = True
+
+    def stop(self) -> None:
+        for p in self.proxies:
+            p.stop()
+
+    def close(self) -> None:
+        for p in self.proxies:
+            p.close()
+        try:
+            self.context.destroy(linger=0)
+        except zmq.ZMQError:
+            pass
+
+    # -- the determinism gate -----------------------------------------------
+    def replay_check(self, max_mismatches: int = 8) -> dict:
+        """Re-derive every discrete-fault decision from the seed and diff
+        against the recorded log.
+
+        For every (link, direction) the run carried messages on, every
+        sequence number is re-decided: RNG-kind events (drop/corrupt/
+        truncate/reorder) must match exactly; sequences with no recorded
+        event must re-decide to no fault; ``partition_drop``/``overflow``
+        are time/backpressure-masked and exempt. One mismatch means the
+        rep is NOT replayable from its seed — the gate fails."""
+        recorded: Dict[Tuple[str, str], Dict[int, str]] = {}
+        max_seq: Dict[Tuple[str, str], int] = {}
+        for e in self.events():
+            if e["seq"] < 0:
+                continue  # time-masked transitions (partition windows)
+            key = (e["link"], e["dir"])
+            recorded.setdefault(key, {})[e["seq"]] = e["kind"]
+            max_seq[key] = max(max_seq.get(key, -1), e["seq"])
+        for p in self.proxies:
+            for d, n in p._seq.items():
+                if n:
+                    key = (p.link, d)
+                    max_seq[key] = max(max_seq.get(key, -1), n - 1)
+        mismatches: List[dict] = []
+        checked = 0
+        for key, top in max_seq.items():
+            link, direction = key
+            seen = recorded.get(key, {})
+            for seq in range(top + 1):
+                got = seen.get(seq)
+                if got in MASK_KINDS:
+                    continue
+                want = self.schedule.decide(link, direction, seq).kind
+                checked += 1
+                if got != want:
+                    if len(mismatches) < max_mismatches:
+                        mismatches.append({
+                            "link": link, "dir": direction, "seq": seq,
+                            "recorded": got, "replayed": want,
+                        })
+        return {
+            "seed": self.schedule.seed,
+            "checked": checked,
+            "events": len(self._events),
+            "events_dropped": self._events_dropped,
+            "match": not mismatches and not self._events_dropped,
+            "mismatches": mismatches,
+        }

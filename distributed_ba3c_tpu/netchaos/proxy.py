@@ -1,0 +1,412 @@
+"""In-process ZMQ proxy pumps that apply a FaultSchedule to one link.
+
+Three proxy shapes cover every socket pair in the fleet/pod port map
+(docs/netchaos.md):
+
+- :class:`PushPullProxy` — PUSH clients -> PULL server (the fleet's c2s
+  experience pipe, the pod's experience channel). One ``fwd`` pump.
+- :class:`PubProxy` — PUB server -> SUB clients (the pod's params
+  broadcast), as the classic XSUB/XPUB relay: data pumps ``rev`` with
+  faults, subscription control frames pass upstream untouched.
+- :class:`RouterProxy` — DEALER clients <-> ROUTER server (the fleet's
+  s2c action pipe, the pod's params fetch). Identity-preserving: the
+  front ROUTER faces the clients, and the proxy materializes ONE back
+  DEALER per observed client identity so the real server sees each
+  client under its own ident (ROUTER_HANDOVER keeps working, replies
+  route correctly). Idents are learned from ``fwd`` traffic, or handed
+  in from outside via :meth:`RouterProxy.ensure_ident` for channels the
+  clients never speak on (the s2c action pipe — its idents are sniffed
+  off the paired c2s proxy's messages by the plane).
+
+Every proxy is one StoppableThread with a Poller loop and a delay heap:
+latency/jitter/bandwidth faults schedule a message's release time,
+discrete faults (drop/corrupt/truncate/reorder) come from the schedule's
+pure per-sequence decision, partitions silence a direction for their
+window, and every injected event is reported to the owning plane — the
+flight-recorded, seed-replayable account the bench gates diff against.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import zmq
+
+from distributed_ba3c_tpu.netchaos.schedule import FaultSchedule
+from distributed_ba3c_tpu.utils.concurrency import StoppableThread
+
+#: poller tick while idle (ms): bounds fault-release latency jitter the
+#: injector itself adds on top of the scheduled delay
+_TICK_MS = 10
+
+
+def _mutate_frames(
+    frames: List[bytes], offset_u: float, flip: bool
+) -> Tuple[List[bytes], bool]:
+    """Corrupt (one bit) or truncate the LARGEST frame — with the block
+    wire that is always an array payload, the exact case the receiving
+    codec's CRC must catch before any ``frombuffer``. Returns (frames,
+    applied); empty messages pass through unfaulted."""
+    if not frames:
+        return frames, False
+    i = max(range(len(frames)), key=lambda j: len(frames[j]))
+    buf = bytearray(frames[i])
+    if not buf:
+        return frames, False
+    pos = int(offset_u * len(buf)) % len(buf)
+    if flip:
+        buf[pos] ^= 1 << (pos % 8)
+        out = bytes(buf)
+    else:
+        out = bytes(buf[:pos])
+    frames = list(frames)
+    frames[i] = out
+    return frames, True
+
+
+class LinkProxy(StoppableThread):
+    """Base pump: sequence accounting, fault application, delay heap."""
+
+    def __init__(self, link: str, schedule: FaultSchedule, plane, name: str):
+        super().__init__(daemon=True, name=name)
+        self.link = link
+        self.schedule = schedule
+        self.plane = plane
+        self._faults = schedule.faults_for(link)
+        self._seq = {"fwd": 0, "rev": 0}
+        self._last_due = {"fwd": 0.0, "rev": 0.0}
+        self._bw_free = {"fwd": 0.0, "rev": 0.0}
+        self._part_state = {"fwd": False, "rev": False}
+        self._pending: List[tuple] = []  # (due, tiebreak, send, frames, seq)
+        self._tiebreak = itertools.count()
+
+    def _partitioned(self, direction: str) -> bool:
+        """Partition = the link STOPS MOVING BYTES: the proxy refuses to
+        drain that direction's intake, so the real sender's own bounds
+        engage exactly as they would against a dead DCN path — the
+        shipper's SNDHWM + spill, PUB's slow-subscriber shedding, the
+        cache's fetch backoff. (The probabilistic ``drop`` fault is the
+        other model: packet loss on a LIVE link — received and
+        discarded.) Window entry/exit is recorded once per transition
+        (seq -1: partitions are time-masked, not RNG-replayed)."""
+        if not self._faults.partitions:
+            return False
+        p = self.schedule.partitioned(self.link, direction, self.plane.t_rel())
+        if p != self._part_state[direction]:
+            self._part_state[direction] = p
+            self.plane.event(
+                self.link, direction, -1,
+                "partition_start" if p else "partition_heal",
+            )
+        return p
+
+    # -- the injection core -------------------------------------------------
+    def _process(
+        self,
+        direction: str,
+        frames: List[bytes],
+        send: Callable[[List[bytes], int], None],
+    ) -> None:
+        seq = self._seq[direction]
+        self._seq[direction] = seq + 1
+        f = self._faults
+        if f.quiet():
+            send(frames, seq)  # clean arm: zero decisions, zero heap
+            return
+        d = self.schedule.decide(self.link, direction, seq)
+        if d.drop:
+            self.plane.event(self.link, direction, seq, "drop")
+            return
+        if d.corrupt:
+            frames, _ = _mutate_frames(frames, d.offset_u, flip=True)
+            # recorded whether or not bytes changed (an all-empty message
+            # has nothing to flip): the log replays the DECISION stream,
+            # and an unlogged decision would read as a seed mismatch
+            self.plane.event(self.link, direction, seq, "corrupt")
+        elif d.truncate:
+            frames, _ = _mutate_frames(frames, d.offset_u, flip=False)
+            self.plane.event(self.link, direction, seq, "truncate")
+        delay = f.latency_ms / 1e3 + d.jitter_u * f.jitter_ms / 1e3
+        if d.reorder:
+            extra = f.reorder_extra_ms or (f.latency_ms + f.jitter_ms + 5.0)
+            delay += extra / 1e3
+            self.plane.event(self.link, direction, seq, "reorder")
+        now = time.monotonic()
+        if f.bandwidth_kbps:
+            size = sum(len(b) for b in frames)
+            transmit = size * 8 / (f.bandwidth_kbps * 1e3)
+            start = max(now, self._bw_free[direction])
+            self._bw_free[direction] = start + transmit
+            due = start + transmit + delay
+        else:
+            due = now + delay
+        if not d.reorder:
+            # FIFO under jitter: a message never overtakes its
+            # predecessor unless the schedule explicitly reordered it
+            due = max(due, self._last_due[direction])
+            self._last_due[direction] = due
+        if due <= now and not self._pending:
+            send(frames, seq)
+            return
+        heapq.heappush(
+            self._pending, (due, next(self._tiebreak), send, frames, seq)
+        )
+
+    def _flush_due(self) -> None:
+        now = time.monotonic()
+        while self._pending and self._pending[0][0] <= now:
+            _, _, send, frames, seq = heapq.heappop(self._pending)
+            send(frames, seq)
+
+    def _poll_timeout_ms(self) -> int:
+        if not self._pending:
+            return _TICK_MS
+        wait = self._pending[0][0] - time.monotonic()
+        return max(0, min(_TICK_MS, int(wait * 1e3)))
+
+    def _flush_all(self) -> None:
+        """Teardown: release everything still in flight immediately (the
+        delayed bytes were 'on the wire'; closing the proxy is not a
+        partition)."""
+        while self._pending:
+            _, _, send, frames, seq = heapq.heappop(self._pending)
+            try:
+                send(frames, seq)
+            except zmq.ZMQError:
+                return
+
+    def _overflow(self, direction: str, seq: int) -> None:
+        """A back/front socket refused the pumped message (its HWM bit):
+        accounted as its own event kind — the proxy never blocks."""
+        self.plane.event(self.link, direction, seq, "overflow")
+
+    def close(self) -> None:
+        self.stop()
+        if self.is_alive():
+            self.join(timeout=2)
+
+
+class PushPullProxy(LinkProxy):
+    """PUSH clients -> [front PULL | back PUSH] -> PULL server."""
+
+    def __init__(
+        self,
+        link: str,
+        schedule: FaultSchedule,
+        plane,
+        front_addr: str,
+        back_addr: str,
+        context: zmq.Context,
+        on_message: Optional[Callable[[List[bytes]], None]] = None,
+        front_hwm: int = 64,
+    ):
+        super().__init__(link, schedule, plane, name=f"netchaos-{link}")
+        self.front_addr, self.back_addr = front_addr, back_addr
+        self._on_message = on_message
+        self._front = context.socket(zmq.PULL)
+        self._front.setsockopt(zmq.LINGER, 0)
+        # the front RCVHWM models the bytes "in flight" on the emulated
+        # wire: during a partition hold, anything past it backs up into
+        # the SENDER's own bounds (SNDHWM -> spill -> typed backpressure)
+        # — a 1000-message default would hide exactly the behavior the
+        # partition rep exists to exercise
+        self._front.setsockopt(zmq.RCVHWM, max(1, int(front_hwm)))
+        self._front.bind(front_addr)
+        self._back = context.socket(zmq.PUSH)
+        self._back.setsockopt(zmq.LINGER, 0)
+        # bounded like every transport socket in this repo: a partitioned
+        # real server turns into counted 'overflow' events here, never
+        # unbounded proxy memory
+        self._back.setsockopt(zmq.SNDHWM, 64)
+        self._back.connect(back_addr)
+
+    def _send_back(self, frames: List[bytes], seq: int) -> None:
+        try:
+            self._back.send_multipart(frames, zmq.NOBLOCK)
+        except zmq.Again:
+            self._overflow("fwd", seq)
+
+    def run(self) -> None:
+        poller = zmq.Poller()
+        poller.register(self._front, zmq.POLLIN)
+        try:
+            while not self.stopped():
+                events = dict(poller.poll(self._poll_timeout_ms()))
+                if self._front in events:
+                    if self._partitioned("fwd"):
+                        # hold, don't drain: the sender's bounds must bite
+                        time.sleep(_TICK_MS / 1e3)
+                    else:
+                        frames = self._front.recv_multipart()
+                        if self._on_message is not None:
+                            self._on_message(frames)
+                        self._process("fwd", frames, self._send_back)
+                self._flush_due()
+            self._flush_all()
+        except (zmq.ContextTerminated, zmq.ZMQError):
+            return
+
+
+class PubProxy(LinkProxy):
+    """PUB server -> [back XSUB | front XPUB] -> SUB clients."""
+
+    def __init__(
+        self,
+        link: str,
+        schedule: FaultSchedule,
+        plane,
+        front_addr: str,
+        back_addr: str,
+        context: zmq.Context,
+    ):
+        super().__init__(link, schedule, plane, name=f"netchaos-{link}")
+        self.front_addr, self.back_addr = front_addr, back_addr
+        self._front = context.socket(zmq.XPUB)
+        self._front.setsockopt(zmq.LINGER, 0)
+        self._front.setsockopt(zmq.SNDHWM, 16)
+        self._front.bind(front_addr)
+        self._back = context.socket(zmq.XSUB)
+        self._back.setsockopt(zmq.LINGER, 0)
+        self._back.connect(back_addr)
+
+    def _send_front(self, frames: List[bytes], seq: int) -> None:
+        try:
+            self._front.send_multipart(frames, zmq.NOBLOCK)
+        except zmq.Again:
+            self._overflow("rev", seq)
+
+    def run(self) -> None:
+        poller = zmq.Poller()
+        poller.register(self._front, zmq.POLLIN)
+        poller.register(self._back, zmq.POLLIN)
+        try:
+            while not self.stopped():
+                events = dict(poller.poll(self._poll_timeout_ms()))
+                if self._back in events:
+                    if self._partitioned("rev"):
+                        # hold: the real PUB's slow-subscriber HWM sheds
+                        # broadcasts upstream, exactly a dead DCN path
+                        time.sleep(_TICK_MS / 1e3)
+                    else:
+                        # published data: the faulted direction
+                        self._process(
+                            "rev", self._back.recv_multipart(),
+                            self._send_front,
+                        )
+                if self._front in events:
+                    # subscription control frames flow upstream untouched
+                    # (faulting them would silently unsubscribe a healthy
+                    # host — not a network fault, a broken injector)
+                    try:
+                        self._back.send_multipart(
+                            self._front.recv_multipart(), zmq.NOBLOCK
+                        )
+                    except zmq.Again:
+                        pass
+                self._flush_due()
+            self._flush_all()
+        except (zmq.ContextTerminated, zmq.ZMQError):
+            return
+
+
+class RouterProxy(LinkProxy):
+    """DEALER clients <-> [front ROUTER | per-ident back DEALERs] <-> ROUTER
+    server, identity-preserving both ways."""
+
+    def __init__(
+        self,
+        link: str,
+        schedule: FaultSchedule,
+        plane,
+        front_addr: str,
+        back_addr: str,
+        context: zmq.Context,
+    ):
+        super().__init__(link, schedule, plane, name=f"netchaos-{link}")
+        self.front_addr, self.back_addr = front_addr, back_addr
+        self._context = context
+        self._front = context.socket(zmq.ROUTER)
+        self._front.setsockopt(zmq.LINGER, 0)
+        # respawned clients reconnect under slot-stable idents — the same
+        # HANDOVER contract the real masters run (docs/actor_plane.md)
+        self._front.setsockopt(zmq.ROUTER_HANDOVER, 1)
+        self._front.bind(front_addr)
+        self._dealers: Dict[bytes, zmq.Socket] = {}
+        import collections
+
+        self._new_idents: "collections.deque[bytes]" = collections.deque()
+        self._poller = zmq.Poller()
+        self._poller.register(self._front, zmq.POLLIN)
+
+    def ensure_ident(self, ident: bytes) -> None:
+        """Register a client identity from OUTSIDE the pump thread (the
+        plane's c2s sniffer feeding the s2c proxy): the back DEALER for it
+        is materialized inside the loop — sockets stay single-threaded."""
+        if ident and ident not in self._dealers:
+            self._new_idents.append(bytes(ident))
+
+    def _ensure_now(self, ident: bytes):
+        sock = self._dealers.get(ident)
+        if sock is None:
+            sock = self._context.socket(zmq.DEALER)
+            sock.setsockopt(zmq.LINGER, 0)
+            sock.setsockopt(zmq.IDENTITY, ident)
+            sock.connect(self.back_addr)
+            self._dealers[ident] = sock
+            self._poller.register(sock, zmq.POLLIN)
+        return sock
+
+    def _send_back(self, ident: bytes):
+        def send(frames: List[bytes], seq: int) -> None:
+            try:
+                self._ensure_now(ident).send_multipart(frames, zmq.NOBLOCK)
+            except zmq.Again:
+                self._overflow("fwd", seq)
+
+        return send
+
+    def _send_front(self, ident: bytes):
+        def send(frames: List[bytes], seq: int) -> None:
+            try:
+                self._front.send_multipart([ident] + frames, zmq.NOBLOCK)
+            except zmq.Again:
+                self._overflow("rev", seq)
+
+        return send
+
+    def run(self) -> None:
+        try:
+            while not self.stopped():
+                while self._new_idents:
+                    self._ensure_now(self._new_idents.popleft())
+                events = dict(self._poller.poll(self._poll_timeout_ms()))
+                held = False
+                if self._front in events:
+                    if self._partitioned("fwd"):
+                        held = True
+                    else:
+                        frames = self._front.recv_multipart()
+                        ident, payload = frames[0], frames[1:]
+                        self._ensure_now(ident)
+                        self._process("fwd", payload, self._send_back(ident))
+                # per-ident back sockets ARE the identity-preserving proxy
+                # structure (one DEALER per client so the real ROUTER sees
+                # true idents) — not a per-env data wire
+                for ident, sock in list(self._dealers.items()):
+                    if sock in events:
+                        if self._partitioned("rev"):
+                            held = True
+                            break
+                        self._process(
+                            "rev", sock.recv_multipart(),  # ba3clint: disable=A6 — ident-preserving proxy fan-in
+                            self._send_front(ident),
+                        )
+                if held:
+                    time.sleep(_TICK_MS / 1e3)
+                self._flush_due()
+            self._flush_all()
+        except (zmq.ContextTerminated, zmq.ZMQError):
+            return

@@ -1,0 +1,209 @@
+"""Seeded, deterministic per-link fault schedules (docs/netchaos.md).
+
+A :class:`FaultSchedule` names every fault the injection plane may apply
+to a link, per direction, per message:
+
+- **latency** (fixed + uniform jitter) and **bandwidth caps** — timing
+  faults, applied by the proxy's delay queue;
+- **drop / corrupt / truncate / reorder** — discrete per-message faults,
+  decided by :meth:`FaultSchedule.decide`;
+- **partitions** — timed windows (full or asymmetric by direction)
+  relative to the plane's start, during which a direction delivers
+  nothing.
+
+The determinism contract is the whole point: ``decide(link, direction,
+seq)`` is a PURE function of ``(schedule seed, link name, direction,
+message sequence number)`` — a counter-based RNG, not a shared stream —
+so a failing rep replays exactly: re-running the same schedule against
+the same message sequence re-injects the same faults, and
+:meth:`NetChaosPlane.replay_check <distributed_ba3c_tpu.netchaos.plane.
+NetChaosPlane.replay_check>` can re-derive a finished run's entire event
+log from the seed alone and diff it against what was flight-recorded.
+
+Discrete faults are mutually exclusive by precedence (drop > corrupt >
+truncate > reorder) so each message carries at most one event and the
+replayed log is unambiguous. JSON round-trips losslessly
+(:meth:`to_json` / :meth:`from_json`); the committed bench artifacts
+embed the schedule so the rep is reproducible from the artifact alone.
+"""
+
+from __future__ import annotations
+
+import binascii
+import dataclasses
+import json
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+import numpy as np
+
+#: message directions through a proxy: ``fwd`` flows toward the bound
+#: (server) side — env steps on c2s, fetch/heartbeats on params_fetch,
+#: shipped blocks on experience; ``rev`` flows back toward the clients —
+#: action replies, fetch replies, params broadcasts
+DIRECTIONS = ("fwd", "rev")
+
+#: discrete per-message fault kinds, in decision precedence order
+RNG_KINDS = ("drop", "corrupt", "truncate", "reorder")
+
+
+@dataclasses.dataclass(frozen=True)
+class Partition:
+    """One timed partition window, in seconds since plane start.
+
+    ``direction``: ``both`` (full partition), or ``fwd``/``rev`` for an
+    asymmetric one (e.g. the learner's broadcasts die while the hosts'
+    fetches still arrive — the exact case the cache's side-channel
+    self-heal exists for)."""
+
+    start_s: float
+    end_s: float
+    direction: str = "both"
+
+    def __post_init__(self):
+        if not 0 <= self.start_s < self.end_s:
+            raise ValueError(
+                f"partition window must satisfy 0 <= start < end, got "
+                f"[{self.start_s}, {self.end_s})"
+            )
+        if self.direction not in ("both",) + DIRECTIONS:
+            raise ValueError(f"unknown partition direction {self.direction!r}")
+
+    def covers(self, direction: str, t_rel: float) -> bool:
+        if self.direction != "both" and self.direction != direction:
+            return False
+        return self.start_s <= t_rel < self.end_s
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkFaults:
+    """Everything the injector may do to one link (both directions)."""
+
+    latency_ms: float = 0.0
+    jitter_ms: float = 0.0
+    drop: float = 0.0
+    corrupt: float = 0.0
+    truncate: float = 0.0
+    reorder: float = 0.0
+    #: extra delay a reordered message takes, so it lands behind its
+    #: successors (0 = latency_ms + jitter_ms + 5 ms, a sane default)
+    reorder_extra_ms: float = 0.0
+    bandwidth_kbps: float = 0.0  # 0 = uncapped
+    partitions: Tuple[Partition, ...] = ()
+
+    def __post_init__(self):
+        for name in ("drop", "corrupt", "truncate", "reorder"):
+            p = getattr(self, name)
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{name} must be a probability, got {p}")
+        for name in (
+            "latency_ms", "jitter_ms", "reorder_extra_ms", "bandwidth_kbps"
+        ):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0")
+        object.__setattr__(
+            self, "partitions", tuple(
+                p if isinstance(p, Partition) else Partition(**p)
+                for p in self.partitions
+            ),
+        )
+
+    def partitioned(self, direction: str, t_rel: float) -> bool:
+        return any(p.covers(direction, t_rel) for p in self.partitions)
+
+    def quiet(self) -> bool:
+        """True when this spec injects nothing (the clean control arm)."""
+        return self == LinkFaults()
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """The discrete + stochastic draws for ONE message — pure, replayable."""
+
+    drop: bool = False
+    corrupt: bool = False
+    truncate: bool = False
+    reorder: bool = False
+    #: uniform [0,1) draws fixed per message: jitter fraction and the
+    #: byte-offset fraction a corrupt/truncate applies at
+    jitter_u: float = 0.0
+    offset_u: float = 0.0
+
+    @property
+    def kind(self) -> Optional[str]:
+        for k in RNG_KINDS:
+            if getattr(self, k):
+                return k
+        return None
+
+
+class FaultSchedule:
+    """Per-link fault specs under one seed; ``"*"`` is the default link."""
+
+    def __init__(self, links: Mapping[str, LinkFaults], seed: int = 0):
+        self.links: Dict[str, LinkFaults] = {}
+        for name, spec in links.items():
+            if isinstance(spec, Mapping):
+                spec = LinkFaults(**spec)
+            if not isinstance(spec, LinkFaults):
+                raise TypeError(f"link {name!r}: expected LinkFaults/dict")
+            self.links[str(name)] = spec
+        self.seed = int(seed)
+        self._none = LinkFaults()
+
+    def faults_for(self, link: str) -> LinkFaults:
+        return self.links.get(link) or self.links.get("*") or self._none
+
+    # -- the pure decision function (THE replay contract) ------------------
+    def decide(self, link: str, direction: str, seq: int) -> Decision:
+        f = self.faults_for(link)
+        if not (f.drop or f.corrupt or f.truncate or f.reorder or f.jitter_ms):
+            return Decision()  # nothing stochastic: skip the RNG entirely
+        # counter-based: a fresh generator keyed by (seed, link, dir, seq)
+        # — no shared stream, so the decision for message N never depends
+        # on how many messages other links (or earlier reps) carried
+        key = (
+            self.seed & 0xFFFFFFFF,
+            binascii.crc32(link.encode()) & 0xFFFFFFFF,
+            DIRECTIONS.index(direction),
+            int(seq) & 0xFFFFFFFF,
+        )
+        u = np.random.default_rng(key).random(6)
+        drop = bool(u[0] < f.drop)
+        corrupt = bool(not drop and u[1] < f.corrupt)
+        truncate = bool(not (drop or corrupt) and u[2] < f.truncate)
+        reorder = bool(not (drop or corrupt or truncate) and u[3] < f.reorder)
+        return Decision(
+            drop=drop, corrupt=corrupt, truncate=truncate, reorder=reorder,
+            jitter_u=float(u[4]), offset_u=float(u[5]),
+        )
+
+    def partitioned(self, link: str, direction: str, t_rel: float) -> bool:
+        return self.faults_for(link).partitioned(direction, t_rel)
+
+    # -- JSON round-trip ----------------------------------------------------
+    def to_json(self) -> str:
+        doc: Dict[str, Any] = {"seed": self.seed, "links": {}}
+        for name, f in self.links.items():
+            d = dataclasses.asdict(f)
+            d["partitions"] = [dataclasses.asdict(p) for p in f.partitions]
+            doc["links"][name] = d
+        return json.dumps(doc, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultSchedule":
+        doc = json.loads(text)
+        if not isinstance(doc, dict) or "links" not in doc:
+            raise ValueError("schedule JSON needs a top-level 'links' map")
+        unknown = set(doc) - {"seed", "links"}
+        if unknown:
+            # a typoed field must fail loudly, not silently inject nothing
+            # (the FleetSpec unknown-field lesson)
+            raise ValueError(f"unknown schedule fields: {sorted(unknown)}")
+        return cls(doc["links"], seed=doc.get("seed", 0))
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, FaultSchedule)
+            and self.seed == other.seed
+            and self.links == other.links
+        )

@@ -42,6 +42,13 @@ from distributed_ba3c_tpu.pod.wire import (  # noqa: F401
     unpack_experience,
     unpack_params,
 )
+from distributed_ba3c_tpu.pod.linkstate import (  # noqa: F401
+    DEGRADED,
+    PARTITIONED,
+    STATES,
+    UP,
+    LinkHealth,
+)
 from distributed_ba3c_tpu.pod.publisher import ParamsPublisher  # noqa: F401
 from distributed_ba3c_tpu.pod.cache import (  # noqa: F401
     StaleParamsCache,

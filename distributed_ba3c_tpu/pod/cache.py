@@ -33,6 +33,7 @@ import zmq
 
 from distributed_ba3c_tpu import telemetry
 from distributed_ba3c_tpu.telemetry import tracing
+from distributed_ba3c_tpu.pod.linkstate import PARTITIONED, UP, LinkHealth
 from distributed_ba3c_tpu.pod.wire import (
     PodEndpoints,
     pod_role,
@@ -40,6 +41,7 @@ from distributed_ba3c_tpu.pod.wire import (
 )
 from distributed_ba3c_tpu.utils import logger
 from distributed_ba3c_tpu.utils.concurrency import StoppableThread
+from distributed_ba3c_tpu.utils.serialize import CorruptFrameError
 
 
 class StaleParamsCache:
@@ -60,11 +62,15 @@ class StaleParamsCache:
         fetch_backoff_s: float = 0.2,
         fetch_backoff_max_s: float = 5.0,
         tele_role: Optional[str] = None,
+        heartbeat_s: float = 1.0,
+        degraded_after_s: float = 3.0,
+        partitioned_after_s: float = 10.0,
     ):
         self.endpoints = endpoints
         self.host = int(host)
         self._backoff0 = fetch_backoff_s
         self._backoff_max = fetch_backoff_max_s
+        self._heartbeat_s = max(0.05, float(heartbeat_s))
         self._params: Optional[Dict[str, Any]] = None
         self.version = -1  # nothing received yet
         self.seen_version = -1  # newest version observed on the wire
@@ -80,8 +86,24 @@ class StaleParamsCache:
         self._c_refreshes = tele.counter("params_refreshes_total")
         self._c_retries = tele.counter("params_fetch_retries_total")
         self._c_malformed = tele.counter("params_malformed_total")
+        self._c_corrupt = tele.counter("params_corrupt_total")
         self._g_version = tele.gauge("params_version")
         self._g_behind = tele.gauge("params_behind", fn=self.behind)
+        # one health machine PER CHANNEL (docs/netchaos.md): an asymmetric
+        # partition — broadcasts dead, fetch path alive — is precisely the
+        # state where the cache must keep refreshing over the ROUTER
+        # side-channel, so collapsing both into one link would erase the
+        # distinction the recovery logic runs on
+        self.sub_link = LinkHealth(
+            "params_sub", role,
+            degraded_after_s=degraded_after_s,
+            partitioned_after_s=partitioned_after_s,
+        )
+        self.fetch_link = LinkHealth(
+            "params_fetch", role,
+            degraded_after_s=degraded_after_s,
+            partitioned_after_s=partitioned_after_s,
+        )
 
         self.context = zmq.Context()
         self._sub = self.context.socket(zmq.SUB)
@@ -93,6 +115,11 @@ class StaleParamsCache:
         self._sub.connect(endpoints.params_pub)
         self._dealer = self.context.socket(zmq.DEALER)
         self._dealer.setsockopt(zmq.LINGER, 0)
+        # stable per-host identity: the publisher names its per-host
+        # link_state gauges from it, and a respawned host re-enters as the
+        # SAME link (the publisher's ROUTER runs HANDOVER for exactly the
+        # reason the actor plane's does — docs/actor_plane.md)
+        self._dealer.setsockopt(zmq.IDENTITY, f"pod-host-{self.host}".encode())
         self._dealer.connect(endpoints.params_fetch)
 
         self._thread = StoppableThread(
@@ -132,6 +159,18 @@ class StaleParamsCache:
         a host that has seen nothing cannot claim a measured lag)."""
         return max(0, self.seen_version - self.version)
 
+    def params_partitioned(self) -> bool:
+        """True when BOTH params channels are partitioned — total loss of
+        contact with the publisher. ``behind()`` cannot grow during a
+        partition (no broadcasts arrive to raise ``seen_version``), so
+        this is the signal the VersionGatedPredictor sheds on instead: a
+        host that cannot measure its lag must not serve as if it were
+        fresh (docs/netchaos.md degraded-mode semantics)."""
+        return (
+            self.sub_link.poll() == PARTITIONED
+            and self.fetch_link.poll() == PARTITIONED
+        )
+
     def on_update(self, cb: Callable[[Any, int], None]) -> None:
         """Register a callback for every applied refresh (refresh-thread
         context). Registered AFTER a first version arrived, the callback
@@ -150,7 +189,11 @@ class StaleParamsCache:
         return self._have_first.wait(timeout)
 
     # -- refresh internals ---------------------------------------------------
-    def _apply(self, payload) -> None:
+    def _apply(self, payload) -> bool:
+        """Apply one snapshot payload; True when it advanced the cache
+        (a same-or-older fetch reply is contact, not progress — the
+        backoff only resets on progress, so a degraded link's probe
+        fetches stay at the capped cadence instead of hammering)."""
         epoch, version, step, params, tr = unpack_params_full(payload)
         # a sampled publish carries a trace context: handshake the
         # learner's clock and park the ref so the apply leg below is
@@ -173,7 +216,7 @@ class StaleParamsCache:
         else:
             self.seen_version = max(self.seen_version, version)
             if version <= self.version:
-                return  # stale broadcast (fetch raced a publish)
+                return False  # stale broadcast (fetch raced a publish)
         with self._lock:
             self._params = params
             self.version = version
@@ -190,6 +233,7 @@ class StaleParamsCache:
         self._c_refreshes.inc()
         self._g_version.set(version)
         self._have_first.set()
+        return True
 
     def _refresh_loop(self) -> None:
         import time
@@ -201,16 +245,22 @@ class StaleParamsCache:
         poller.register(self._dealer, zmq.POLLIN)
         backoff = self._backoff0
         next_fetch = 0.0  # monotonic time of the next fetch (re)attempt
+        next_hb = 0.0  # monotonic time of the next heartbeat probe
         first_attempt = True
         while not t.stopped():
             now = time.monotonic()
-            if self._params is None and now >= next_fetch:
-                # the late-joiner path: ask the ROUTER for the current
-                # snapshot instead of waiting out a publish interval. A
-                # request that gets no (or an empty) reply inside the
-                # backoff window is simply re-sent — DEALER sends never
-                # block rollout, and the monkey killing a host mid-run is
-                # exactly this path on the respawn side.
+            # fetch when we hold nothing (the late-joiner path) OR when
+            # the broadcast channel has gone silent past its degraded
+            # threshold (the asymmetric-partition self-heal: broadcasts
+            # lost, ROUTER side-channel possibly alive). Either way the
+            # cadence is the same bounded backoff — a partitioned learner
+            # is probed at ``fetch_backoff_max_s``, never hammered, and a
+            # heal is adopted on the first reply that lands (a restarted
+            # learner's new epoch included — the rejoin contract _apply
+            # owns). DEALER sends never block rollout.
+            if (
+                self._params is None or self.sub_link.poll() != UP
+            ) and now >= next_fetch:
                 try:
                     self._dealer.send(b"fetch", zmq.NOBLOCK)
                 except zmq.ZMQError:
@@ -220,25 +270,51 @@ class StaleParamsCache:
                 first_attempt = False
                 next_fetch = now + backoff
                 backoff = min(self._backoff_max, backoff * 2)
+            if now >= next_hb:
+                # heartbeat probe on the fetch channel: the publisher
+                # beats this host's per-link machine and acks with an
+                # empty frame, so BOTH ends keep a live account of the
+                # link even between real fetches (docs/netchaos.md)
+                try:
+                    self._dealer.send(b"hb", zmq.NOBLOCK)
+                except zmq.ZMQError:
+                    pass
+                next_hb = now + self._heartbeat_s
             try:
                 events = dict(poller.poll(100))
                 if self._dealer in events:
                     reply = self._dealer.recv()
+                    # ANY reply — snapshot, empty pre-first-publish frame,
+                    # or a heartbeat ack — is contact on the fetch channel
+                    self.fetch_link.beat()
                     if reply and self._apply_safe(reply):
                         backoff = self._backoff0
+                        next_fetch = 0.0
                 if self._sub in events:
-                    self._apply_safe(self._sub.recv())
+                    payload = self._sub.recv()
+                    self.sub_link.beat()
+                    self._apply_safe(payload)
             except (zmq.ContextTerminated, zmq.ZMQError):
                 return
 
     def _apply_safe(self, payload) -> bool:
-        """Apply one payload; a malformed frame (port-band collision,
-        learner/host message-format skew) must COUNT and keep the refresh
-        loop alive, not kill the one thread that could ever recover —
-        same contract as PodIngest's malformed-block handling."""
+        """Apply one payload; True only when it ADVANCED the cache. A
+        malformed frame (port-band collision, learner/host message-format
+        skew) must COUNT and keep the refresh loop alive, not kill the
+        one thread that could ever recover — same contract as PodIngest's
+        malformed-block handling. A CRC-failed frame counts under its own
+        typed ``params_corrupt_total`` (bytes changed in flight, not a
+        sender bug — the runbook branches on the distinction)."""
         try:
-            self._apply(payload)
-            return True
+            return self._apply(payload)
+        except CorruptFrameError as e:
+            self._c_corrupt.inc()
+            telemetry.record(
+                "corrupt_frame", wire="pod-params", role=self.tele_role,
+                error=str(e)[:200],
+            )
+            logger.error("pod params cache dropped a corrupt payload: %r", e)
+            return False
         except Exception as e:  # msgpack raises its own hierarchy too
             self._c_malformed.inc()
             logger.error(
@@ -266,9 +342,18 @@ class VersionGatedPredictor:
         behind_fn: Callable[[], int],
         max_staleness: int,
         tele_role: str = "pod.host0",
+        partitioned_fn: Optional[Callable[[], bool]] = None,
     ):
+        """``partitioned_fn`` (typically ``cache.params_partitioned``)
+        extends the gate to total params loss: during a partition no
+        broadcast can raise ``seen_version``, so ``behind_fn`` reads 0
+        exactly when the host is MOST stale — the link-state machine is
+        the signal that survives, and shedding through the same typed
+        path keeps every lockstep server stepping on uniform fallback
+        instead of wedging (docs/netchaos.md)."""
         self._pred = predictor
         self._behind = behind_fn
+        self._partitioned = partitioned_fn
         self.max_staleness = int(max_staleness)
         self._c_stale_sheds = telemetry.registry(tele_role).counter(
             "stale_params_sheds_total"
@@ -284,7 +369,9 @@ class VersionGatedPredictor:
         self._pred.update_params(params, policy=policy)
 
     def _stale(self) -> bool:
-        return self._behind() > self.max_staleness
+        if self._behind() > self.max_staleness:
+            return True
+        return self._partitioned is not None and self._partitioned()
 
     def _shed(self, k: int, shed_callback) -> bool:
         from distributed_ba3c_tpu.predict.server import ShedReject

@@ -29,6 +29,7 @@ the respawned cache rejoins at the current version via the fetch channel).
 from __future__ import annotations
 
 import argparse
+import collections
 import functools
 import os
 import signal
@@ -45,6 +46,7 @@ from distributed_ba3c_tpu.telemetry import tracing
 from distributed_ba3c_tpu.actors.vtrace_master import VTraceSimulatorMaster
 from distributed_ba3c_tpu.data.dataflow import claim_trace, collate_rollout
 from distributed_ba3c_tpu.pod.cache import StaleParamsCache, VersionGatedPredictor
+from distributed_ba3c_tpu.pod.linkstate import LinkHealth
 from distributed_ba3c_tpu.pod.wire import pack_experience, pod_endpoints, pod_role
 from distributed_ba3c_tpu.utils import logger
 from distributed_ba3c_tpu.utils.concurrency import StoppableThread
@@ -73,8 +75,17 @@ class ExperienceShipper(StoppableThread):
     would make the ``--max_staleness`` bound looser than the data; the
     conservative stamp can only over-measure, never under-measure, and
     the correction itself reads recorded log-probs, not the stamp).
-    Sends are non-blocking: a dead/partitioned learner costs dropped
-    blocks (counted), never a wedged actor plane.
+
+    Partition tolerance (docs/netchaos.md): the PUSH socket carries an
+    explicit SNDHWM so a partitioned ingest can buffer at most
+    ``snd_hwm`` blocks inside libzmq — never unbounded learner-side RAM
+    growth on the host. When that bound bites (``zmq.Again``) the block
+    spills into a bounded DROP-OLDEST buffer (``ship_backpressure_total``
+    counts every refusal, ``shipped_dropped_total`` counts blocks the
+    spill evicted), the ``experience`` LinkHealth machine tracks the
+    silence, and the spill re-drains oldest-first the moment a send lands
+    again — a heal ships the freshest bounded window of history, rollout
+    never blocked for a microsecond of it.
     """
 
     def __init__(
@@ -85,6 +96,10 @@ class ExperienceShipper(StoppableThread):
         host: int,
         segments_per_block: int,
         tele_role: Optional[str] = None,
+        snd_hwm: int = 8,
+        spill_depth: int = 64,
+        degraded_after_s: float = 3.0,
+        partitioned_after_s: float = 10.0,
     ):
         super().__init__(daemon=True, name=f"pod-shipper-h{host}")
         import zmq
@@ -96,13 +111,25 @@ class ExperienceShipper(StoppableThread):
         self.context = zmq.Context()
         self._push = self.context.socket(zmq.PUSH)
         self._push.setsockopt(zmq.LINGER, 0)
-        self._push.set_hwm(4)
+        # the explicit BOUND on learner-ward buffering: libzmq holds at
+        # most this many blocks for a slow/partitioned ingest; everything
+        # past it is this class's accounted spill, not silent RAM
+        self._push.setsockopt(zmq.SNDHWM, max(1, int(snd_hwm)))
         self._push.connect(experience_addr)
+        self._spill: collections.deque = collections.deque()
+        self._spill_depth = max(1, int(spill_depth))
         role = tele_role or pod_role(host)
         self.tele_role = role
         tele = telemetry.registry(role)
         self._c_shipped = tele.counter("shipped_blocks_total")
         self._c_dropped = tele.counter("shipped_dropped_total")
+        self._c_backpressure = tele.counter("ship_backpressure_total")
+        tele.gauge("ship_spill_depth", fn=lambda: len(self._spill))
+        self.link = LinkHealth(
+            "experience", role,
+            degraded_after_s=degraded_after_s,
+            partitioned_after_s=partitioned_after_s,
+        )
 
     def _scalars(self) -> dict:
         """The piggybacked host-progress snapshot (folded into the
@@ -117,18 +144,70 @@ class ExperienceShipper(StoppableThread):
             "stale_params_sheds_total": p.get("stale_params_sheds_total", 0.0),
             "shipped_blocks_total": p.get("shipped_blocks_total", 0.0),
             "shipped_dropped_total": p.get("shipped_dropped_total", 0.0),
+            "ship_backpressure_total": p.get("ship_backpressure_total", 0.0),
+            "params_fetch_retries_total": p.get(
+                "params_fetch_retries_total", 0.0
+            ),
+            "params_corrupt_total": p.get("params_corrupt_total", 0.0),
+            "params_malformed_total": p.get("params_malformed_total", 0.0),
         }
 
+    def _try_send(self, frames) -> bool:
+        """One non-blocking send attempt; True when libzmq accepted the
+        message. Acceptance beats the link (a partitioned peer stops
+        accepting within SNDHWM messages); refusal is the typed
+        backpressure account."""
+        import zmq
+
+        try:
+            self._push.send_multipart(frames, zmq.NOBLOCK, copy=False)
+        except zmq.Again:
+            self._c_backpressure.inc()
+            self.link.poll()
+            return False
+        self._c_shipped.inc()
+        self.link.beat()
+        return True
+
+    def _ship(self, frames) -> None:
+        """Ship oldest-first through the bounded drop-oldest spill."""
+        self._spill.append(frames)
+        while len(self._spill) > self._spill_depth:
+            # the bound bites: shed the OLDEST block — under staleness
+            # semantics old experience is the cheapest to lose (its lag
+            # would be measured and possibly gate-rejected anyway)
+            self._spill.popleft()
+            self._c_dropped.inc()
+        while self._spill and self._try_send(self._spill[0]):
+            self._spill.popleft()
+
     def run(self) -> None:
+        import queue as _queue
+
         import zmq
 
         holder: List[dict] = []
         stamp = (0, 0)  # (epoch, version) at the block's first segment
         trace = None  # sampled trace riding the block being collated
         while not self.stopped():
-            seg = self.queue_get_stoppable(self.master.queue, timeout=0.2)
-            if seg is None:
-                break
+            try:
+                # bounded single-attempt get (NOT queue_get_stoppable,
+                # which only returns on item-or-stop): idle ticks must
+                # still drain the spill and poll the link so a heal is
+                # taken within one timeout even when rollout is quiet
+                seg = self.master.queue.get(timeout=0.2)
+            except _queue.Empty:
+                if self._spill:
+                    try:
+                        while self._spill and self._try_send(self._spill[0]):
+                            self._spill.popleft()
+                    except zmq.ZMQError:
+                        return  # socket torn down (close raced run)
+                # no spill and nothing to ship = no attempts = no evidence:
+                # the link state FREEZES at its last observed value (an
+                # idle host must not drift to "partitioned" on silence it
+                # caused itself — only refused sends are evidence here)
+                continue
             ref = claim_trace(seg)
             if ref is not None:
                 # emit -> shipper drain: the host-side ship wait (one
@@ -155,12 +234,9 @@ class ExperienceShipper(StoppableThread):
                 trace=ctx,
             )
             try:
-                self._push.send_multipart(frames, zmq.NOBLOCK, copy=False)
-                self._c_shipped.inc()
-            except zmq.Again:
-                self._c_dropped.inc()
+                self._ship(frames)
             except zmq.ZMQError:
-                return
+                return  # socket torn down mid-send (close raced run)
 
     def close(self) -> None:
         self.stop()
@@ -258,7 +334,12 @@ def main(argv: Optional[list] = None) -> int:
     serving = predictor
     if args.max_staleness > 0:
         serving = VersionGatedPredictor(
-            predictor, cache.behind, args.max_staleness, tele_role=role
+            predictor, cache.behind, args.max_staleness, tele_role=role,
+            # a params-partitioned host sheds through the SAME typed gate:
+            # behind() cannot grow while no broadcast arrives, so the
+            # link-state machine is the staleness signal that survives a
+            # partition (docs/netchaos.md)
+            partitioned_fn=cache.params_partitioned,
         )
 
     # 3. the host-local actor plane

@@ -35,6 +35,7 @@ from distributed_ba3c_tpu.pod.wire import (
     unpack_experience_full,
 )
 from distributed_ba3c_tpu.utils.concurrency import StoppableThread
+from distributed_ba3c_tpu.utils.serialize import CorruptFrameError
 
 
 @dataclasses.dataclass
@@ -83,6 +84,11 @@ class PodIngest:
         self._c_blocks = tele.counter("pod_ingest_blocks_total")
         self._c_steps = tele.counter("pod_ingest_env_steps_total")
         self._c_dropped = tele.counter("pod_ingest_dropped_total")
+        # typed wire rejects: corrupt = CRC failed in flight (netchaos /
+        # flaky DCN), rejected = structurally undecodable (version skew,
+        # stray sender) — the runbook branches on the distinction
+        self._c_corrupt = tele.counter("pod_corrupt_frames_total")
+        self._c_rejected = tele.counter("pod_ingest_rejected_total")
         self._g_depth = tele.gauge(
             "pod_ingest_depth", fn=lambda: len(self._buf)
         )
@@ -161,9 +167,23 @@ class PodIngest:
                 host, epoch, version, scalars, batch, tr = (
                     unpack_experience_full([f.buffer for f in frames])
                 )
-            except (ValueError, KeyError, TypeError) as e:
+            except CorruptFrameError as e:
                 from distributed_ba3c_tpu.utils import logger
 
+                # typed integrity reject: the CRC caught in-flight
+                # corruption/truncation BEFORE any frombuffer view was
+                # built — count it and keep the one receive thread alive
+                self._c_corrupt.inc()
+                telemetry.record(
+                    "corrupt_frame", wire="pod-experience",
+                    error=str(e)[:200],
+                )
+                logger.error("pod ingest dropped a corrupt block: %r", e)
+                continue
+            except Exception as e:  # msgpack raises its own hierarchy too
+                from distributed_ba3c_tpu.utils import logger
+
+                self._c_rejected.inc()
                 logger.error("pod ingest dropped a malformed block: %r", e)
                 continue
             T, B = batch["action"].shape

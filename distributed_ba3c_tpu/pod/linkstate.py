@@ -1,0 +1,129 @@
+"""Per-link health state machines for the pod transport (docs/netchaos.md).
+
+Every cross-host channel in the pod gets one :class:`LinkHealth`:
+``up -> degraded -> partitioned`` driven purely by *observed contact*
+(received payloads, heartbeat acks, successful sends) against monotonic
+silence thresholds — the transport's own account of the network, not a
+guess from the fault injector. Transitions are flight-recorded
+(``link_state`` events, so a postmortem dump shows exactly when a link
+died and healed) and exported as ``link_state_<name>`` gauges
+(0 = up, 1 = degraded, 2 = partitioned) on the owning role's registry.
+
+The machine never *acts*; it only *names* the condition. The actions live
+at the call sites: the params cache re-arms its bounded-backoff fetch when
+its SUB channel degrades (the asymmetric-partition self-heal), the
+VersionGatedPredictor sheds through the staleness gate when BOTH params
+channels are partitioned (a host that cannot know its lag must not
+pretend it is fresh), and the experience shipper spills to its bounded
+drop-oldest buffer while its PUSH link refuses sends — rollout never
+wedges on any of it.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Optional
+
+from distributed_ba3c_tpu import telemetry
+
+#: canonical state names, index == gauge value
+STATES = ("up", "degraded", "partitioned")
+UP, DEGRADED, PARTITIONED = STATES
+
+_NAME_RE = re.compile(r"[^A-Za-z0-9_]+")
+
+
+def metric_link_name(raw) -> str:
+    """Sanitize an arbitrary link/ident name into the Prometheus-safe
+    metric suffix (the telemetry plane's ASCII-grammar lesson: one junk
+    name would poison the whole scrape). Capped so stray senders on a
+    bound port cannot mint unbounded-length series names."""
+    if isinstance(raw, bytes):
+        raw = raw.decode("utf-8", "replace")
+    return _NAME_RE.sub("_", str(raw)).strip("_")[:32] or "link"
+
+
+class LinkHealth:
+    """One link's ``up/degraded/partitioned`` machine.
+
+    ``beat()`` on every observed contact; ``poll()`` re-derives the state
+    from monotonic silence and returns it (recording the transition the
+    first time it is observed). Both are safe from any thread: the hot
+    half is one monotonic read + one float store (GIL-atomic), and state
+    transitions only happen inside ``poll`` — worst case two racing
+    pollers record the same transition twice, never a torn state.
+    """
+
+    def __init__(
+        self,
+        link: str,
+        role: str,
+        degraded_after_s: float = 3.0,
+        partitioned_after_s: float = 10.0,
+        gauge_name: Optional[str] = None,
+    ):
+        if not 0 < degraded_after_s <= partitioned_after_s:
+            raise ValueError(
+                f"need 0 < degraded_after_s <= partitioned_after_s, got "
+                f"{degraded_after_s}/{partitioned_after_s}"
+            )
+        self.link = str(link)
+        self.role = str(role)
+        self.degraded_after_s = float(degraded_after_s)
+        self.partitioned_after_s = float(partitioned_after_s)
+        self._last_contact = time.monotonic()
+        self._state = UP
+        name = gauge_name or f"link_state_{metric_link_name(link)}"
+        self._gauge = telemetry.registry(role).gauge(name)
+        self._gauge.set(0.0)
+        self._c_transitions = telemetry.registry(role).counter(
+            "link_transitions_total"
+        )
+
+    # -- inputs -------------------------------------------------------------
+    def beat(self) -> None:
+        """Contact observed (payload received, send accepted, ack seen)."""
+        self._last_contact = time.monotonic()
+        if self._state != UP:
+            self._transition(UP, 0.0)
+
+    # -- outputs ------------------------------------------------------------
+    def silent_s(self) -> float:
+        return time.monotonic() - self._last_contact
+
+    def poll(self) -> str:
+        """Current state, re-derived from silence; records transitions."""
+        silent = self.silent_s()
+        if silent >= self.partitioned_after_s:
+            state = PARTITIONED
+        elif silent >= self.degraded_after_s:
+            state = DEGRADED
+        else:
+            state = UP
+        if state != self._state:
+            self._transition(state, silent)
+        return state
+
+    @property
+    def state(self) -> str:
+        """Last derived state (no re-derivation — use :meth:`poll` on any
+        path that must observe fresh silence)."""
+        return self._state
+
+    def partitioned(self) -> bool:
+        return self.poll() == PARTITIONED
+
+    # -- internals ----------------------------------------------------------
+    def _transition(self, state: str, silent: float) -> None:
+        prev, self._state = self._state, state
+        self._gauge.set(float(STATES.index(state)))
+        self._c_transitions.inc()
+        telemetry.record(
+            "link_state",
+            link=self.link,
+            role=self.role,
+            frm=prev,
+            to=state,
+            silent_s=round(silent, 3),
+        )

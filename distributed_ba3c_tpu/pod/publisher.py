@@ -25,15 +25,21 @@ Two sockets, one contract (docs/pod.md):
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 import zmq
 
 from distributed_ba3c_tpu import telemetry
 from distributed_ba3c_tpu.telemetry import tracing
+from distributed_ba3c_tpu.pod.linkstate import LinkHealth, metric_link_name
 from distributed_ba3c_tpu.pod.wire import PodEndpoints, pack_params
 from distributed_ba3c_tpu.utils import logger
 from distributed_ba3c_tpu.utils.concurrency import StoppableThread
+
+#: per-host link machines are capped like every untrusted-ident table in
+#: the telemetry plane: a stray sender churning fresh idents on the bound
+#: port must not mint unbounded gauges (the 4096-ident piggyback lesson)
+_MAX_HOST_LINKS = 256
 
 
 class ParamsPublisher:
@@ -66,14 +72,29 @@ class ParamsPublisher:
         self._pub.bind(endpoints.params_pub)
         self._router = self.context.socket(zmq.ROUTER)
         self._router.setsockopt(zmq.LINGER, 0)
+        # a respawned host reconnects under its slot-stable DEALER
+        # identity; without HANDOVER libzmq keeps the ident bound to the
+        # dead predecessor's half-open pipe and silently rejects the new
+        # peer — the exact wedge the actor plane's chaos bench found
+        self._router.setsockopt(zmq.ROUTER_HANDOVER, 1)
         self._router.bind(endpoints.params_fetch)
         self._latest: Optional[bytes] = None  # atomic ref swap
         self.version = 0
 
         tele = telemetry.registry(tele_role)
+        self.tele_role = tele_role
         self._c_publishes = tele.counter("pod_params_publishes_total")
         self._c_fetches = tele.counter("pod_params_fetches_total")
+        self._c_heartbeats = tele.counter("pod_params_heartbeats_total")
         self._g_version = tele.gauge("pod_params_version")
+        # learner-side per-host link machines, driven by fetch/heartbeat
+        # arrivals on the ROUTER channel: the publisher cannot see its PUB
+        # subscribers, but every healthy cache heartbeats this channel, so
+        # ``link_state_<host>`` on the LEARNER's scrape endpoint is the
+        # operator's one-stop partition map (docs/netchaos.md)
+        self._links: Dict[bytes, LinkHealth] = {}
+        self.heartbeat_degraded_s = 3.0
+        self.heartbeat_partitioned_s = 10.0
 
         self._thread = StoppableThread(
             target=self._serve_fetches, daemon=True, name="pod-params-fetch"
@@ -134,6 +155,28 @@ class ParamsPublisher:
             # fetch channel (or the next publish) catches them up
             pass
 
+    def _beat_link(self, ident: bytes) -> None:
+        link = self._links.get(ident)
+        if link is None:
+            if len(self._links) >= _MAX_HOST_LINKS:
+                return  # cap: junk idents must not mint unbounded gauges
+            link = self._links[ident] = LinkHealth(
+                ident, self.tele_role,
+                degraded_after_s=self.heartbeat_degraded_s,
+                partitioned_after_s=self.heartbeat_partitioned_s,
+                gauge_name=f"link_state_{metric_link_name(ident)}",
+            )
+        link.beat()
+
+    def link_states(self) -> Dict[str, str]:
+        """Freshly polled per-host link states (operator/bench surface).
+        Snapshots the table first — the serve thread mints links for
+        first-contact idents concurrently."""
+        return {
+            metric_link_name(i): l.poll()
+            for i, l in list(self._links.items())
+        }
+
     def _serve_fetches(self) -> None:
         import threading
 
@@ -144,11 +187,27 @@ class ParamsPublisher:
         while not t.stopped():
             try:
                 if not poller.poll(200):
+                    # silence is information too: re-derive every host's
+                    # link state so the gauges (and flight transitions)
+                    # move even while no host can reach us
+                    for link in self._links.values():
+                        link.poll()
                     continue
                 frames = self._router.recv_multipart()
             except (zmq.ContextTerminated, zmq.ZMQError):
                 return
             ident = frames[0]
+            self._beat_link(ident)
+            if len(frames) > 1 and bytes(frames[1]) == b"hb":
+                # heartbeat probe (pod/cache.py): ack with an empty frame
+                # so the cache's fetch_link beats on the round-trip; never
+                # ship a whole snapshot for a liveness check
+                self._c_heartbeats.inc()
+                try:
+                    self._router.send_multipart([ident, b""])
+                except zmq.ZMQError:
+                    pass
+                continue
             latest = self._latest
             self._c_fetches.inc()
             try:

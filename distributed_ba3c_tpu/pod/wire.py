@@ -134,13 +134,17 @@ def pack_params(
     step: Optional[int] = None,
     epoch: int = 0,
     trace: Optional[list] = None,
+    crc: Optional[bool] = None,
 ) -> bytes:
     """One params snapshot message (PUB broadcast == fetch reply).
 
     ``trace`` is a sampled trace-context element
     (telemetry/tracing.py ``encode_context``) riding as an optional
     ``"tr"`` key — dict-keyed messages version by key presence the way
-    the block headers version by length; old receivers ignore it."""
+    the block headers version by length; old receivers ignore it.
+    ``crc`` (None = the BA3C_WIRE_CRC process default) adds the
+    single-frame CRC32 prefix so a corrupted snapshot becomes a typed
+    ``CorruptFrameError`` at the cache instead of torn weights."""
     doc = {
         "e": int(epoch),
         "v": int(version),
@@ -149,7 +153,7 @@ def pack_params(
     }
     if trace is not None:
         doc["tr"] = trace
-    return dumps(doc)
+    return dumps(doc, crc=crc)
 
 
 def unpack_params(payload) -> Tuple[int, int, int, Dict[str, Any]]:
@@ -189,6 +193,7 @@ def pack_experience(
     scalars: Optional[Dict[str, float]] = None,
     epoch: int = 0,
     trace: Optional[list] = None,
+    crc: Optional[bool] = None,
 ) -> List[Any]:
     """One stamped experience block as a zero-copy multipart message.
 
@@ -214,7 +219,7 @@ def pack_experience(
     }
     if trace is not None:
         meta["tr"] = trace
-    return pack_block(meta, [batch[k] for k in EXPERIENCE_KEYS])
+    return pack_block(meta, [batch[k] for k in EXPERIENCE_KEYS], crc=crc)
 
 
 def unpack_experience(

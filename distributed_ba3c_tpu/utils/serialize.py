@@ -18,16 +18,70 @@ Two codecs live here:
   memory (no ``tobytes``), the unpack side returns ``np.frombuffer`` views
   over the received frames (no copy). This is the block wire's codec
   (docs/actor_plane.md).
+
+**Integrity framing (docs/netchaos.md):** both codecs optionally carry
+CRC32s so a corrupted-in-flight frame becomes a typed
+:class:`CorruptFrameError` at the receiver instead of a silently wrong
+array (a bit-flipped obs buffer reshapes fine and poisons training with
+zero signal; a truncated one must never reach ``frombuffer``). The block
+header grows a third element — per-frame CRCs — and single-frame
+messages get a 4-byte magic + CRC prefix; both are length/prefix
+versioned, so CRC-off senders parse unchanged at CRC-aware receivers.
+Enable fleet-wide with ``BA3C_WIRE_CRC=1`` (cli ``--wire_crc``), or per
+call with ``crc=True``. ``CorruptFrameError`` subclasses ``ValueError``
+so every pre-existing untrusted-wire handler already contains it; the
+receive loops additionally count it as its own typed reject
+(``corrupt_frames_total``).
 """
 
 from __future__ import annotations
 
-from typing import Any, List, Sequence, Tuple
+import binascii
+import os
+import struct
+from typing import Any, List, Optional, Sequence, Tuple
 
 import msgpack
 import numpy as np
 
 _NDARRAY_EXT = 42
+
+#: prefix of a CRC-framed single-frame message: 3 magic bytes + version,
+#: then the little-endian CRC32 of the payload, then the payload. No
+#: legitimate ``dumps`` output starts with 0xBA (our top level is always a
+#: msgpack array/map), so prefix detection cannot misfire on old senders.
+_CRC_MAGIC = b"\xba\x3c\xc3\x01"
+
+
+class CorruptFrameError(ValueError):
+    """A frame failed its CRC32 (or CRC framing was structurally broken).
+
+    Subclasses ValueError on purpose: every receive loop that already
+    drops undecodable wire input keeps working; loops that care count it
+    separately as the typed ``corrupt_frame`` reject (docs/netchaos.md).
+    """
+
+
+_wire_crc = os.environ.get("BA3C_WIRE_CRC", "0").lower() not in (
+    "0", "", "false",
+)
+
+
+def wire_crc_enabled() -> bool:
+    """Process-wide CRC default (``BA3C_WIRE_CRC=1`` / :func:`set_wire_crc`);
+    the per-call ``crc=`` argument overrides it."""
+    return _wire_crc
+
+
+def set_wire_crc(flag: bool) -> None:
+    """Flip the process-wide CRC default (cli.py's ``--wire_crc``; exported
+    as BA3C_WIRE_CRC for child processes so a whole fleet agrees)."""
+    global _wire_crc
+    _wire_crc = bool(flag)
+
+
+def _crc(buf) -> int:
+    return binascii.crc32(buf) & 0xFFFFFFFF
 
 
 def _default(obj: Any):
@@ -56,9 +110,17 @@ def _ext_hook(code: int, data: bytes):
     return arr.reshape(shape)
 
 
-def dumps(obj: Any) -> bytes:
-    """Serialize to msgpack bytes (ndarray-aware)."""
-    return msgpack.packb(obj, use_bin_type=True, default=_default)
+def dumps(obj: Any, crc: Optional[bool] = None) -> bytes:
+    """Serialize to msgpack bytes (ndarray-aware).
+
+    ``crc`` (None = the :func:`wire_crc_enabled` process default) prefixes
+    the payload with ``_CRC_MAGIC + crc32`` so the receiving :func:`loads`
+    verifies integrity before any array view is built.
+    """
+    payload = msgpack.packb(obj, use_bin_type=True, default=_default)
+    if wire_crc_enabled() if crc is None else crc:
+        return _CRC_MAGIC + struct.pack("<I", _crc(payload)) + payload
+    return payload
 
 
 def loads(buf) -> Any:
@@ -66,12 +128,25 @@ def loads(buf) -> Any:
 
     Accepts any bytes-like object (``bytes``, ``memoryview``, ``zmq.Frame``
     buffers) so non-copying ZMQ receives decode without a round-trip through
-    ``bytes()``.
+    ``bytes()``. CRC-framed payloads (prefix-detected) are verified first:
+    a mismatch — corruption OR truncation in flight — raises the typed
+    :class:`CorruptFrameError` instead of handing back a wrong object.
     """
-    return msgpack.unpackb(buf, raw=False, ext_hook=_ext_hook)
+    view = memoryview(buf)
+    if len(view) >= 8 and bytes(view[:4]) == _CRC_MAGIC:
+        (want,) = struct.unpack("<I", view[4:8])
+        payload = view[8:]
+        if _crc(payload) != want:
+            raise CorruptFrameError(
+                f"single-frame payload failed CRC32 ({len(payload)} bytes)"
+            )
+        return msgpack.unpackb(payload, raw=False, ext_hook=_ext_hook)
+    return msgpack.unpackb(view, raw=False, ext_hook=_ext_hook)
 
 
-def pack_block(meta: Any, arrays: Sequence[np.ndarray]) -> List[Any]:
+def pack_block(
+    meta: Any, arrays: Sequence[np.ndarray], crc: Optional[bool] = None
+) -> List[Any]:
     """Multipart zero-copy encode: ``[header, raw_buf_0, ..., raw_buf_n]``.
 
     ``meta`` is any msgpack-serializable object (the block wire puts the
@@ -81,16 +156,31 @@ def pack_block(meta: Any, arrays: Sequence[np.ndarray]) -> List[Any]:
     caller hands a strided view). The caller must not mutate the arrays
     until the message is known to have left the process — the block wire's
     lockstep send→await-actions structure guarantees exactly that.
+
+    ``crc`` (None = the process default) appends a third, length-versioned
+    header element: per-frame CRC32s, covering the header's own bytes-to-be
+    indirectly through msgpack structure and every payload frame exactly.
+    Still zero-copy — the CRC is one read-only pass over buffers zmq is
+    about to read anyway.
     """
+    use_crc = wire_crc_enabled() if crc is None else crc
     specs: List[Tuple[str, Tuple[int, ...]]] = []
     frames: List[Any] = [b""]  # placeholder for the header
+    crcs: List[int] = []
     for a in arrays:
         a = np.ascontiguousarray(a)
         specs.append((a.dtype.str, a.shape))
         frames.append(a.data)
-    frames[0] = msgpack.packb(
-        (meta, specs), use_bin_type=True, default=_default
-    )
+        if use_crc:
+            crcs.append(_crc(a.data))
+    header: Tuple = (meta, specs, crcs) if use_crc else (meta, specs)
+    packed = msgpack.packb(header, use_bin_type=True, default=_default)
+    if use_crc:
+        # the header frame carries its OWN prefix CRC too: a flipped bit
+        # in meta/specs would otherwise mis-route or mis-shape silently —
+        # the payload CRCs cannot vouch for the frame that declares them
+        packed = _CRC_MAGIC + struct.pack("<I", _crc(packed)) + packed
+    frames[0] = packed
     return frames
 
 
@@ -101,13 +191,46 @@ def unpack_block(frames: Sequence[Any]) -> Tuple[Any, List[np.ndarray]]:
     Every returned array is a ``frombuffer`` VIEW over its frame — zero
     copies; the arrays keep the frames alive for as long as they are
     referenced.
+
+    A 3-element header carries per-frame CRC32s (length-versioned: the
+    2-element form parses exactly as before): every payload frame is
+    verified BEFORE any ``frombuffer`` view is built, and a mismatch
+    raises the typed :class:`CorruptFrameError` — a truncated or
+    bit-flipped frame must never become an array.
     """
-    meta, specs = msgpack.unpackb(frames[0], raw=False, ext_hook=_ext_hook)
+    hview = memoryview(frames[0])
+    if len(hview) >= 8 and bytes(hview[:4]) == _CRC_MAGIC:
+        (want,) = struct.unpack("<I", hview[4:8])
+        hview = hview[8:]
+        if _crc(hview) != want:
+            raise CorruptFrameError(
+                f"block header failed CRC32 ({len(hview)} bytes)"
+            )
+    header = msgpack.unpackb(hview, raw=False, ext_hook=_ext_hook)
+    if not isinstance(header, (tuple, list)) or len(header) not in (2, 3):
+        raise ValueError(
+            f"block header is not a (meta, specs[, crcs]) tuple: "
+            f"{type(header).__name__}/{len(header) if isinstance(header, (tuple, list)) else '?'}"
+        )
+    meta, specs = header[0], header[1]
+    crcs = header[2] if len(header) == 3 else None
     if len(specs) != len(frames) - 1:
         raise ValueError(
             f"block header declares {len(specs)} arrays but the message "
             f"carries {len(frames) - 1} payload frames"
         )
+    if crcs is not None:
+        if len(crcs) != len(frames) - 1:
+            raise CorruptFrameError(
+                f"block header carries {len(crcs)} CRCs for "
+                f"{len(frames) - 1} payload frames"
+            )
+        for i, (want, buf) in enumerate(zip(crcs, frames[1:])):
+            if _crc(buf) != want:
+                raise CorruptFrameError(
+                    f"block payload frame {i} failed CRC32 "
+                    f"({len(memoryview(buf))} bytes on the wire)"
+                )
     arrays = [
         np.frombuffer(buf, dtype=np.dtype(dtype_str)).reshape(shape)
         for (dtype_str, shape), buf in zip(specs, frames[1:])

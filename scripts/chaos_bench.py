@@ -348,6 +348,53 @@ def _phase_failover(args) -> dict:
     }
 
 
+def _phase_network(args) -> dict:
+    """The netchaos phase (docs/netchaos.md): the pod's DCN-shaped links
+    under emulated 50 ms RTT + 1% loss must hold >= --net_gate of the
+    clean-proxy control, a timed full partition must heal restart-free
+    with only typed counters, and every rep must replay from its seed."""
+    from distributed_ba3c_tpu.netchaos.bench import (
+        NetShape,
+        dcn_schedule,
+        quiet_schedule,
+        run_partition_rep,
+        run_throughput_rep,
+    )
+
+    shape = NetShape(
+        hosts=1,
+        sims_per_host=args.net_sims,
+        segments_per_block=8,
+        warmup_timeout=args.warmup_timeout_net,
+    )
+    clean = run_throughput_rep(
+        shape, quiet_schedule(args.seed), args.net_seconds, args.net_windows
+    )
+    dcn = run_throughput_rep(
+        shape,
+        dcn_schedule(args.net_rtt_ms, args.net_loss, seed=args.seed),
+        args.net_seconds,
+        args.net_windows,
+    )
+    ratio = round(dcn["rate"] / max(clean["rate"], 1e-9), 4)
+    partition = run_partition_rep(shape, args.seed, partition_s=10.0)
+    return {
+        "rtt_ms": args.net_rtt_ms,
+        "loss": args.net_loss,
+        "clean": clean,
+        "dcn": dcn,
+        "dcn_over_clean": ratio,
+        "gate": args.net_gate,
+        "gate_passed": ratio >= args.net_gate,
+        "partition": partition,
+        "replay_ok": bool(
+            clean["replay"]["match"]
+            and dcn["replay"]["match"]
+            and partition["replay"]["match"]
+        ),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--game", default="pong")
@@ -384,6 +431,25 @@ def main() -> int:
     ap.add_argument("--skip_failover", action="store_true")
     ap.add_argument("--skip_autoscale", action="store_true")
     ap.add_argument(
+        "--net", action="store_true",
+        help="add the netchaos network phase: pod-link throughput under "
+        "--net_rtt_ms/--net_loss vs a quiet-proxy control, the "
+        "partition-and-heal rep, and the seed-replay verdict "
+        "(docs/netchaos.md)",
+    )
+    ap.add_argument(
+        "--net_only", action="store_true",
+        help="run ONLY the network phase (no native env core needed — "
+        "the pod rig runs fake env hosts); the CI netchaos job's mode",
+    )
+    ap.add_argument("--net_rtt_ms", type=float, default=50.0)
+    ap.add_argument("--net_loss", type=float, default=0.01)
+    ap.add_argument("--net_gate", type=float, default=0.85, help="degraded pod throughput must hold >= this x the quiet-proxy control")
+    ap.add_argument("--net_seconds", type=float, default=6.0)
+    ap.add_argument("--net_windows", type=int, default=2)
+    ap.add_argument("--net_sims", type=int, default=2, help="fake sims per pod host in the network phase")
+    ap.add_argument("--warmup_timeout_net", type=float, default=240.0)
+    ap.add_argument(
         "--failover_steps_per_epoch", type=int, default=60,
         help="failover phase train.py epoch length (checkpoint cadence)",
     )
@@ -392,6 +458,35 @@ def main() -> int:
     from distributed_ba3c_tpu import telemetry
     from distributed_ba3c_tpu.envs import native
     from distributed_ba3c_tpu.utils.devicelock import stderr_print
+
+    if args.net_only:
+        # the network phase is self-contained (fake-env pod hosts): its
+        # own JSON, its own gates, evidence before verdict
+        net = _phase_network(args)
+        stderr_print(
+            f"network: clean {net['clean']['rate']:.1f} vs "
+            f"{net['rtt_ms']:.0f}ms/{100 * net['loss']:.1f}% "
+            f"{net['dcn']['rate']:.1f} env-steps/s "
+            f"({net['dcn_over_clean']:.3f}x, gate {net['gate']}), "
+            f"partition recovered={net['partition']['recovered']}, "
+            f"replay={net['replay_ok']}"
+        )
+        out = {
+            "metric": "netchaos_pod_dcn_over_clean",
+            "value": net["dcn_over_clean"],
+            "unit": "ratio (degraded/clean ingest env-steps/s)",
+            "network": net,
+        }
+        # evidence prints BEFORE the verdict (the repo's bench contract)
+        print(json.dumps(out))
+        ok = (
+            net["gate_passed"]
+            and net["partition"]["recovered"]
+            and net["replay_ok"]
+        )
+        if not ok:
+            stderr_print(f"network phase gates FAILED: {json.dumps(net)[:500]}")
+        return 0 if ok else 1
 
     if not native.available():
         stderr_print("native env core not built: run `make -C cpp`")
@@ -461,6 +556,27 @@ def main() -> int:
         if not failover["ok"]:
             failures.append(f"learner checkpoint-failover FAILED: {failover}")
 
+    network = None
+    if args.net:
+        network = _phase_network(args)
+        stderr_print(
+            f"network: clean {network['clean']['rate']:.1f} vs degraded "
+            f"{network['dcn']['rate']:.1f} env-steps/s "
+            f"({network['dcn_over_clean']:.3f}x, gate {network['gate']})"
+        )
+        if not network["gate_passed"]:
+            failures.append(
+                f"netchaos throughput gate FAILED: degraded pod held only "
+                f"{network['dcn_over_clean']:.3f}x clean (gate "
+                f">={network['gate']})"
+            )
+        if not network["partition"]["recovered"]:
+            failures.append(
+                f"partition-and-heal rep FAILED: {network['partition']}"
+            )
+        if not network["replay_ok"]:
+            failures.append("netchaos seed-replay mismatch (rep not reproducible)")
+
     # the orchestration flight events ARE the acceptance evidence: dump the
     # ring (postmortem form) and embed the relevant kinds in the artifact
     flight = telemetry.flight_recorder()
@@ -492,6 +608,7 @@ def main() -> int:
         "reps": reps,
         "autoscale": autoscale,
         "failover": failover,
+        "network": network,
         "flight_dump": dump_path,
         "flight_event_kinds": kinds,
         "flight_events": events[-200:],

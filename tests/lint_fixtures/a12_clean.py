@@ -1,0 +1,23 @@
+"""A12 clean fixture: every sanctioned bounded-wait shape."""
+import zmq
+
+
+def poller_guarded_recv(sock):
+    poller = zmq.Poller()
+    poller.register(sock, zmq.POLLIN)
+    while True:
+        if not poller.poll(200):
+            continue
+        return sock.recv()  # bounded by the poll timeout above
+
+
+def nonblocking_send(push_sock, frames):
+    try:
+        push_sock.send_multipart(frames, zmq.NOBLOCK)
+    except zmq.Again:
+        return False
+    return True
+
+
+def nonblocking_flag_kw(dealer_sock, payload):
+    dealer_sock.send(payload, flags=zmq.DONTWAIT)

@@ -1,0 +1,12 @@
+"""A12 fixture: blocking ZMQ waits with no bound."""
+import zmq
+
+
+def bare_recv_parks_forever(context, addr):
+    dealer = context.socket(zmq.DEALER)
+    dealer.connect(addr)
+    return dealer.recv()  # no poller, no NOBLOCK, no RCVTIMEO
+
+
+def bare_send_parks_on_full_peer(push_sock, frames):
+    push_sock.send_multipart(frames)  # no bound: a partitioned PULL wedges this

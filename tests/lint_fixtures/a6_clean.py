@@ -1,9 +1,13 @@
 """A6 clean: the block wire and the loop shapes that are NOT per-env ops."""
+import zmq
 
 SNDMORE = 2
 
 
 def serve_block(n_envs, push, dealer, frames, rewards):
+    # bounded waits (A12): these sockets carry send/recv timeouts
+    push.setsockopt(zmq.SNDTIMEO, 2000)
+    dealer.setsockopt(zmq.RCVTIMEO, 2000)
     # the block wire: ONE multipart send + ONE batched reply for all B envs
     push.send_multipart(frames, copy=False)
     reply = dealer.recv_multipart()

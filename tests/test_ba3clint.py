@@ -19,7 +19,7 @@ from tools.ba3clint.engine import suppressions
 FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-RULE_IDS = ["J1", "J2", "J3", "J4", "J5", "J6", "A1", "A2", "A3", "A4", "A5", "A6", "A7", "A8", "A9", "A10", "A11"]
+RULE_IDS = ["J1", "J2", "J3", "J4", "J5", "J6", "A1", "A2", "A3", "A4", "A5", "A6", "A7", "A8", "A9", "A10", "A11", "A12"]
 
 
 def _fixture(name):
@@ -76,6 +76,22 @@ def test_expected_flag_counts():
     assert len(_findings("j6_flagged.py", "J6")) == 4
     assert len(_findings("a9_flagged.py", "A9")) == 5
     assert len(_findings("a11_flagged.py", "A11")) == 4
+    assert len(_findings("a12_flagged.py", "A12")) == 2
+
+
+def test_a12_file_level_sockopt_timeout_sanctions(tmp_path):
+    """RCVTIMEO/SNDTIMEO anywhere in the file bounds its blocking ops."""
+    p = tmp_path / "timeo.py"
+    p.write_text(
+        "import zmq\n"
+        "def make(context, addr):\n"
+        "    dealer = context.socket(zmq.DEALER)\n"
+        "    dealer.setsockopt(zmq.RCVTIMEO, 2000)\n"
+        "    dealer.connect(addr)\n"
+        "    return dealer.recv()\n"
+    )
+    hits = [f for f in lint_file(str(p), all_rules()) if f.rule == "A12"]
+    assert not hits, hits
 
 
 def test_a7_exempts_telemetry_package(tmp_path):

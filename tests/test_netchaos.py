@@ -1,0 +1,467 @@
+"""The netchaos fault-injection plane + the transport hardening it forced.
+
+Contracts pinned here (ISSUE 13):
+
+- schedule: pure per-sequence decisions (same seed -> same faults,
+  forever), lossless JSON round-trip, loud rejection of junk, fault
+  precedence exclusivity, timed/asymmetric partition windows.
+- proxies: drop and latency actually injected on a live push/pull link;
+  partitions HOLD the link so the sender's own bounds engage; the
+  identity-preserving router proxy carries fetch round-trips; the whole
+  pod wrap (pub + router + push/pull) serves a real publisher/cache pair
+  with heartbeats flowing.
+- replay: a finished run's event log re-derives exactly from the seed
+  (the determinism gate every bench artifact embeds).
+- link-state machines: up -> degraded -> partitioned on silence,
+  beat-recovery, gauge export, flight-recorded transitions.
+- degraded-mode: the experience shipper against a dead ingest spills to
+  its bounded drop-oldest buffer with ``ship_backpressure_total``
+  ticking and re-drains on heal; a params-partitioned host sheds through
+  the VersionGatedPredictor's typed path.
+"""
+
+import queue
+import time
+import types
+
+import numpy as np
+import pytest
+import zmq
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.netchaos import (
+    FaultSchedule,
+    LinkFaults,
+    NetChaosPlane,
+    Partition,
+)
+from distributed_ba3c_tpu.pod import (
+    DEGRADED,
+    PARTITIONED,
+    UP,
+    LinkHealth,
+    ParamsPublisher,
+    StaleParamsCache,
+    VersionGatedPredictor,
+)
+from distributed_ba3c_tpu.pod.host import ExperienceShipper
+from distributed_ba3c_tpu.pod.wire import pod_endpoints
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset_all()
+    yield
+    telemetry.reset_all()
+
+
+def _free_base():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"tcp://127.0.0.1:{port}", f"tcp://127.0.0.1:{port + 1}"
+
+
+# ---------------------------------------------------------------------------
+# schedule
+# ---------------------------------------------------------------------------
+
+def test_decisions_are_pure_functions_of_seed_link_dir_seq():
+    s1 = FaultSchedule(
+        {"x": LinkFaults(drop=0.3, corrupt=0.2, truncate=0.1, jitter_ms=4)},
+        seed=11,
+    )
+    s2 = FaultSchedule.from_json(s1.to_json())
+    for seq in range(200):
+        a, b = s1.decide("x", "fwd", seq), s2.decide("x", "fwd", seq)
+        assert a == b
+    # different seed, link or direction -> a different stream
+    s3 = FaultSchedule({"x": LinkFaults(drop=0.3, corrupt=0.2)}, seed=12)
+    kinds = [s1.decide("x", "fwd", i).kind for i in range(64)]
+    assert kinds != [s3.decide("x", "fwd", i).kind for i in range(64)]
+    assert kinds != [s1.decide("y", "fwd", i).kind for i in range(64)]
+    assert kinds != [s1.decide("x", "rev", i).kind for i in range(64)]
+
+
+def test_faults_are_mutually_exclusive_per_message():
+    s = FaultSchedule(
+        {"x": LinkFaults(drop=0.5, corrupt=0.5, truncate=0.5, reorder=0.5)},
+        seed=3,
+    )
+    for seq in range(300):
+        d = s.decide("x", "fwd", seq)
+        assert sum([d.drop, d.corrupt, d.truncate, d.reorder]) <= 1
+
+
+def test_schedule_json_round_trip_with_partitions():
+    s = FaultSchedule(
+        {
+            "params_pub": LinkFaults(
+                latency_ms=25, jitter_ms=5, drop=0.01,
+                partitions=(Partition(2.0, 6.0, "rev"),),
+            ),
+            "*": LinkFaults(bandwidth_kbps=512),
+        },
+        seed=42,
+    )
+    s2 = FaultSchedule.from_json(s.to_json())
+    assert s2 == s
+    assert s2.partitioned("params_pub", "rev", 3.0)
+    assert not s2.partitioned("params_pub", "fwd", 3.0)  # asymmetric
+    assert not s2.partitioned("params_pub", "rev", 6.0)  # half-open window
+    # "*" default applies to unnamed links
+    assert s2.faults_for("anything").bandwidth_kbps == 512
+
+
+def test_schedule_rejects_junk_loudly():
+    with pytest.raises(ValueError):
+        LinkFaults(drop=1.5)
+    with pytest.raises(ValueError):
+        LinkFaults(latency_ms=-1)
+    with pytest.raises(ValueError):
+        Partition(5.0, 2.0)
+    with pytest.raises(ValueError):
+        Partition(0.0, 1.0, "sideways")
+    with pytest.raises(ValueError):
+        FaultSchedule.from_json('{"links": {}, "sede": 1}')  # typoed field
+    with pytest.raises(ValueError):
+        FaultSchedule.from_json("[1, 2]")
+
+
+def test_quiet_schedule_decides_nothing():
+    s = FaultSchedule({}, seed=0)
+    assert s.faults_for("any").quiet()
+    assert s.decide("any", "fwd", 7).kind is None
+
+
+# ---------------------------------------------------------------------------
+# proxies
+# ---------------------------------------------------------------------------
+
+def _pull_all(sock, timeout_ms=500):
+    got = []
+    poller = zmq.Poller()
+    poller.register(sock, zmq.POLLIN)
+    while poller.poll(timeout_ms):
+        got.append(sock.recv_multipart())
+    return got
+
+
+def test_push_pull_proxy_injects_drop_and_latency():
+    plane = NetChaosPlane(
+        FaultSchedule({"l": LinkFaults(latency_ms=40, drop=0.25)}, seed=5)
+    )
+    ctx = zmq.Context()
+    server = ctx.socket(zmq.PULL)
+    port = server.bind_to_random_port("tcp://127.0.0.1")
+    front = plane.add_push_pull("l", f"tcp://127.0.0.1:{port}")
+    plane.start()
+    client = ctx.socket(zmq.PUSH)
+    client.connect(front)
+    time.sleep(0.3)
+    t0 = time.monotonic()
+    for i in range(60):
+        client.send_multipart([b"m", b"%d" % i])
+    got = _pull_all(server)
+    first_latency = None
+    if got:
+        first_latency = time.monotonic() - t0  # upper bound incl. drain
+    drops = plane.summary().get("drop", 0)
+    assert drops > 0 and len(got) == 60 - drops
+    assert first_latency is None or first_latency >= 0.04
+    # FIFO preserved under pure latency (no reorder configured)
+    seqs = [int(m[1]) for m in got]
+    assert seqs == sorted(seqs)
+    rc = plane.replay_check()
+    assert rc["match"], rc
+    plane.close()
+    client.close(0)
+    server.close(0)
+    ctx.term()
+
+
+def test_partition_holds_link_then_heals():
+    """During the window the link moves NOTHING (the sender's bounds are
+    what engages); after it, delivery resumes — and the transitions are
+    flight-recorded."""
+    sched = FaultSchedule(
+        {"l": LinkFaults(partitions=(Partition(0.0, 1.0),))}, seed=1
+    )
+    plane = NetChaosPlane(sched)
+    ctx = zmq.Context()
+    server = ctx.socket(zmq.PULL)
+    port = server.bind_to_random_port("tcp://127.0.0.1")
+    front = plane.add_push_pull("l", f"tcp://127.0.0.1:{port}")
+    plane.start()
+    client = ctx.socket(zmq.PUSH)
+    client.set_hwm(1000)
+    client.connect(front)
+    time.sleep(0.2)
+    plane.rebase_clock()  # window [0, 1) starts NOW
+    for i in range(10):
+        client.send_multipart([b"%d" % i])
+    time.sleep(0.3)
+    assert _pull_all(server, timeout_ms=100) == []  # held, not delivered
+    got = _pull_all(server, timeout_ms=1500)  # heal at t=1 releases them
+    assert len(got) == 10
+    kinds = {e["kind"] for e in plane.events()}
+    assert "partition_start" in kinds and "partition_heal" in kinds
+    assert plane.replay_check()["match"]
+    plane.close()
+    client.close(0)
+    server.close(0)
+    ctx.term()
+
+
+def test_corruption_through_proxy_is_caught_by_crc():
+    from distributed_ba3c_tpu.utils.serialize import (
+        CorruptFrameError,
+        pack_block,
+        unpack_block,
+    )
+
+    plane = NetChaosPlane(
+        FaultSchedule({"l": LinkFaults(corrupt=1.0)}, seed=2)
+    )
+    ctx = zmq.Context()
+    server = ctx.socket(zmq.PULL)
+    port = server.bind_to_random_port("tcp://127.0.0.1")
+    front = plane.add_push_pull("l", f"tcp://127.0.0.1:{port}")
+    plane.start()
+    client = ctx.socket(zmq.PUSH)
+    client.connect(front)
+    time.sleep(0.3)
+    obs = np.arange(4096, dtype=np.uint8).reshape(64, 64)
+    client.send_multipart(pack_block([b"id", 0, 1], [obs], crc=True))
+    (frames,) = _pull_all(server)
+    with pytest.raises(CorruptFrameError):
+        unpack_block(frames)
+    assert plane.summary().get("corrupt", 0) == 1
+    plane.close()
+    client.close(0)
+    server.close(0)
+    ctx.term()
+
+
+def test_pod_wrap_serves_publisher_and_cache_through_all_three_proxies():
+    c2s, s2c = _free_base()
+    real = pod_endpoints(c2s, s2c)
+    plane = NetChaosPlane(
+        FaultSchedule({"params_pub": LinkFaults(latency_ms=10)}, seed=4)
+    )
+    front = plane.wrap_pod(c2s, s2c)
+    plane.start()
+    pub = ParamsPublisher(real)
+    pub.start()
+    cache = StaleParamsCache(
+        pod_endpoints(*front), host=0, fetch_backoff_s=0.1, heartbeat_s=0.2
+    )
+    cache.start()
+    try:
+        params = {"w": np.arange(4, dtype=np.float32)}
+        pub.publish(1, params, step=10)  # before any broadcast reaches SUB,
+        assert cache.wait_first(15)      # the cache FETCHES through the proxy
+        for v in range(2, 5):
+            pub.publish(v, params, step=v)
+        deadline = time.monotonic() + 10
+        while cache.version < 4 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert cache.version == 4 and cache.epoch == pub.epoch
+        # heartbeats flowed: the publisher tracks this host's link as up
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if pub.link_states().get("pod_host_0") == UP:
+                break
+            time.sleep(0.05)
+        assert pub.link_states().get("pod_host_0") == UP
+        assert cache.fetch_link.poll() == UP
+        # the SUB channel beats only on broadcasts — publish once more and
+        # it must come back up within the proxy latency
+        pub.publish(5, params, step=5)
+        deadline = time.monotonic() + 5
+        while cache.sub_link.poll() != UP and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert cache.sub_link.poll() == UP
+        assert plane.replay_check()["match"]
+    finally:
+        cache.close()
+        pub.close()
+        plane.close()
+
+
+# ---------------------------------------------------------------------------
+# link-state machine
+# ---------------------------------------------------------------------------
+
+def test_link_health_transitions_and_gauge():
+    link = LinkHealth(
+        "t", "learner", degraded_after_s=0.1, partitioned_after_s=0.25
+    )
+    g = telemetry.registry("learner").gauge("link_state_t")
+    assert link.poll() == UP and g.value() == 0.0
+    time.sleep(0.12)
+    assert link.poll() == DEGRADED and g.value() == 1.0
+    time.sleep(0.18)
+    assert link.poll() == PARTITIONED and g.value() == 2.0
+    assert link.partitioned()
+    link.beat()
+    assert link.poll() == UP and g.value() == 0.0
+    # transitions were flight-recorded
+    evs = [
+        f for _, k, f in telemetry.flight_recorder().events_since(0)
+        if k == "link_state" and f.get("link") == "t"
+    ]
+    states = [(e["frm"], e["to"]) for e in evs]
+    assert (UP, DEGRADED) in states and (DEGRADED, PARTITIONED) in states
+    assert (PARTITIONED, UP) in states
+
+
+def test_link_health_rejects_inverted_thresholds():
+    with pytest.raises(ValueError):
+        LinkHealth("t", "learner", degraded_after_s=5, partitioned_after_s=1)
+
+
+# ---------------------------------------------------------------------------
+# degraded-mode semantics
+# ---------------------------------------------------------------------------
+
+def _segment(T=3, H=8):
+    return {
+        "state": np.zeros((T, H, H, 4), np.uint8),
+        "action": np.zeros(T, np.int32),
+        "reward": np.zeros(T, np.float32),
+        "done": np.zeros(T, np.float32),
+        "behavior_log_probs": np.zeros(T, np.float32),
+        "behavior_values": np.zeros(T, np.float32),
+        "bootstrap_state": np.zeros((H, H, 4), np.uint8),
+    }
+
+
+def _make_shipper(addr, snd_hwm=2, spill_depth=4):
+    master = types.SimpleNamespace(
+        queue=queue.Queue(maxsize=1024), tele_role="master"
+    )
+    cache = types.SimpleNamespace(epoch=1, version=3)
+    return ExperienceShipper(
+        master, cache, addr, host=0, segments_per_block=1,
+        snd_hwm=snd_hwm, spill_depth=spill_depth,
+        degraded_after_s=0.3, partitioned_after_s=0.8,
+    )
+
+
+def test_shipper_spills_bounded_drop_oldest_and_redrains_on_heal():
+    """A partitioned ingest: the SNDHWM bites, blocks spill (counted),
+    the spill stays bounded by evicting the OLDEST, rollout's queue keeps
+    draining — and a healed ingest receives the bounded freshest window,
+    oldest-first."""
+    ctx = zmq.Context()
+    port = ctx.socket(zmq.PULL)  # reserve a port, then DON'T listen yet
+    p = port.bind_to_random_port("tcp://127.0.0.1")
+    port.close(0)
+    addr = f"tcp://127.0.0.1:{p}"
+    shipper = _make_shipper(addr, snd_hwm=2, spill_depth=4)
+    tele = telemetry.registry(shipper.tele_role)
+    shipper.start()
+    try:
+        for _ in range(16):
+            shipper.master.queue.put(_segment(), timeout=1)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if (
+                shipper.master.queue.qsize() == 0
+                and tele.scalars().get("ship_backpressure_total", 0) > 0
+                and len(shipper._spill) == 4
+            ):
+                break
+            time.sleep(0.05)
+        s = tele.scalars()
+        assert shipper.master.queue.qsize() == 0  # rollout never blocked
+        assert s["ship_backpressure_total"] > 0  # the bound bit, counted
+        assert len(shipper._spill) == 4  # bounded
+        assert s["shipped_dropped_total"] > 0  # drop-oldest, counted
+        time.sleep(0.4)  # past degraded_after_s with sends still refused
+        shipper.master.queue.put(_segment(), timeout=1)  # one more attempt
+        deadline = time.monotonic() + 5
+        while shipper.link.state == UP and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert shipper.link.state != UP  # refusal observed, state moved
+        # heal: bind the ingest; the spill must drain without new input
+        server = ctx.socket(zmq.PULL)
+        try:
+            server.bind(addr)
+            got = _pull_all(server, timeout_ms=2000)
+            assert len(got) >= 4  # spill + whatever libzmq held at the HWM
+            deadline = time.monotonic() + 5
+            while len(shipper._spill) and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert len(shipper._spill) == 0
+            assert shipper.link.state == UP  # sends land again (beat)
+        finally:
+            server.close(0)
+    finally:
+        shipper.close()
+        ctx.term()
+
+
+def test_version_gate_sheds_on_partition_signal():
+    from distributed_ba3c_tpu.predict.server import ShedReject
+
+    sheds = []
+
+    class _NeverCalled:
+        num_actions = 4
+
+        def put_task(self, *a, **k):  # pragma: no cover
+            raise AssertionError("partitioned host must not serve")
+
+        def put_block_task(self, *a, **k):  # pragma: no cover
+            raise AssertionError("partitioned host must not serve")
+
+    partitioned = {"v": True}
+    gate = VersionGatedPredictor(
+        _NeverCalled(), behind_fn=lambda: 0, max_staleness=4,
+        tele_role="pod.host0", partitioned_fn=lambda: partitioned["v"],
+    )
+    ok = gate.put_task(
+        np.zeros((8, 8, 4), np.uint8), lambda *a: None,
+        shed_callback=lambda r: sheds.append(r),
+    )
+    assert ok is False and isinstance(sheds[0], ShedReject)
+    assert sheds[0].reason == "stale_params"
+    assert (
+        telemetry.registry("pod.host0").scalars()["stale_params_sheds_total"]
+        == 1
+    )
+    # heal: behind()==0 and no partition -> serve again (reaches the
+    # wrapped predictor, which raises — proving the gate opened)
+    partitioned["v"] = False
+    with pytest.raises(AssertionError):
+        gate.put_task(np.zeros((8, 8, 4), np.uint8), lambda *a: None)
+
+
+# ---------------------------------------------------------------------------
+# bench plumbing (fast pieces only; the live rig is the slow CI phase)
+# ---------------------------------------------------------------------------
+
+def test_dcn_schedule_shapes():
+    from distributed_ba3c_tpu.netchaos.bench import (
+        POD_LINKS,
+        corrupt_schedule,
+        dcn_schedule,
+        partition_schedule,
+        quiet_schedule,
+    )
+
+    s = dcn_schedule(rtt_ms=50, loss=0.01, seed=9)
+    for link in POD_LINKS:
+        f = s.faults_for(link)
+        assert f.latency_ms == 25.0 and f.drop == 0.01
+    assert quiet_schedule().faults_for("experience").quiet()
+    p = partition_schedule(2.0, 4.0, seed=1)
+    assert p.partitioned("experience", "fwd", 3.0)
+    assert not p.partitioned("experience", "fwd", 6.5)
+    c = corrupt_schedule(seed=1)
+    assert c.faults_for("experience").corrupt > 0

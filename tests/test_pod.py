@@ -236,6 +236,98 @@ def test_cache_adopts_new_epoch_despite_lower_version(ipc_endpoints):
         cache.close()
 
 
+def test_cache_retry_backoff_ceiling_against_unreachable_publisher(
+    ipc_endpoints,
+):
+    """ISSUE-13 satellite: the PR-12 retry path, partition-shaped. Against
+    an endpoint where NOTHING answers, the fetch retries with backoff up
+    to the ceiling and no further — bounded probing, not hammering — and
+    nothing on the serving surface ever blocks."""
+    cache = StaleParamsCache(
+        ipc_endpoints, host=0,
+        fetch_backoff_s=0.05, fetch_backoff_max_s=0.2,
+    )
+    cache.start()
+    try:
+        time.sleep(1.3)
+        retries = telemetry.registry("pod.host0").scalars()[
+            "params_fetch_retries_total"
+        ]
+        # doubling 0.05 -> cap 0.2 gives ~8 attempts in 1.3 s; a flat
+        # 0.05 cadence (no backoff) would give ~26, a stuck loop 0. The
+        # band proves BOTH halves: it keeps retrying AND the ceiling is
+        # respected.
+        assert 3 <= retries <= 14, retries
+        # rollout-facing surface never blocks on the dead publisher
+        t0 = time.monotonic()
+        assert cache.params is None
+        assert cache.behind() == 0  # nothing seen -> no measurable lag
+        assert not cache.wait_first(0.05)
+        assert time.monotonic() - t0 < 0.5
+    finally:
+        cache.close()
+
+
+def test_cache_rejoins_current_epoch_when_publisher_heals(ipc_endpoints):
+    """Unreachable-then-healed: the publisher that finally appears is a
+    NEW lifetime (fresh epoch, versions from 0) — the rejoining cache
+    must adopt it through the retrying fetch path."""
+    cache = StaleParamsCache(
+        ipc_endpoints, host=0,
+        fetch_backoff_s=0.05, fetch_backoff_max_s=0.2,
+    )
+    cache.start()
+    try:
+        assert not cache.wait_first(0.5)  # provably unreachable first
+        pub = ParamsPublisher(ipc_endpoints, epoch=333)
+        pub.start()
+        pub.publish(7, {"w": np.full(2, 7.0, np.float32)})
+        try:
+            assert cache.wait_first(10)  # the RETRY landed, no restart
+            assert (cache.epoch, cache.version) == (333, 7)
+            np.testing.assert_array_equal(
+                cache.params["w"], np.full(2, 7.0, np.float32)
+            )
+        finally:
+            pub.close()
+    finally:
+        cache.close()
+
+
+def test_cache_degraded_broadcast_channel_probes_fetch(ipc_endpoints):
+    """Asymmetric-partition self-heal: when the SUB channel goes silent
+    past its degraded threshold, the cache re-arms the bounded-backoff
+    fetch even though it HOLDS params — and catches up to versions it
+    never saw broadcast."""
+    pub = ParamsPublisher(ipc_endpoints)
+    pub.start()
+    cache = StaleParamsCache(
+        ipc_endpoints, host=0,
+        fetch_backoff_s=0.05, fetch_backoff_max_s=0.2,
+        heartbeat_s=0.1, degraded_after_s=0.3, partitioned_after_s=2.0,
+    )
+    cache.start()
+    try:
+        pub.publish(1, {"w": np.zeros(2, np.float32)})
+        assert cache.wait_first(10)
+        assert _wait(lambda: cache.version == 1)
+        # "lose" the broadcast: arm the fetch channel's latest WITHOUT a
+        # PUB send — exactly a dead broadcast path with a live ROUTER
+        pub._latest = None
+        from distributed_ba3c_tpu.pod.wire import pack_params
+
+        pub._latest = pack_params(2, {"w": np.ones(2, np.float32)}, epoch=pub.epoch)
+        # past degraded_after_s the cache must probe the fetch channel and
+        # adopt the version the broadcast never delivered
+        assert _wait(lambda: cache.version == 2, timeout=10)
+        from distributed_ba3c_tpu.pod.linkstate import UP
+
+        assert cache.fetch_link.poll() == UP  # side-channel alive
+    finally:
+        cache.close()
+        pub.close()
+
+
 def test_learner_rejects_foreign_epoch_blocks(pod_parts, ipc_endpoints):
     """A block stamped under a publisher lifetime the learner does not
     own carries a version from the wrong lineage — typed rejection (the

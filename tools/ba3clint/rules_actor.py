@@ -969,6 +969,102 @@ class OrphanSpanRule(Rule):
         return False
 
 
+#: flag fragments that mark a send/recv as explicitly non-blocking
+_NOBLOCK_FRAGMENTS = ("NOBLOCK", "DONTWAIT")
+
+#: setsockopt names that give a socket's blocking ops a bounded timeout
+_TIMEOUT_SOCKOPTS = ("RCVTIMEO", "SNDTIMEO")
+
+
+def _has_noblock_flag(ctx: FileContext, call: ast.Call) -> bool:
+    exprs = list(call.args) + [kw.value for kw in call.keywords]
+    for e in exprs:
+        for sub in ast.walk(e):
+            nm = dotted_name(sub)
+            if nm and any(f in nm for f in _NOBLOCK_FRAGMENTS):
+                return True
+    return False
+
+
+def _scope_has_bounded_poll(node: ast.AST) -> bool:
+    """The enclosing function contains a ``.poll(<timeout>)`` call — the
+    Poller-guarded loop shape, where the recv only fires on POLLIN and
+    the wait itself is bounded by the poll timeout."""
+    fns = enclosing_functions(node)
+    scope = fns[0] if fns else None
+    if scope is None:
+        return False
+    for sub in ast.walk(scope):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if isinstance(f, ast.Attribute) and f.attr == "poll":
+            if sub.args or any(kw.arg == "timeout" for kw in sub.keywords):
+                return True
+    return False
+
+
+def _file_sets_socket_timeout(ctx: FileContext) -> bool:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "setsockopt"):
+            continue
+        for arg in node.args:
+            nm = dotted_name(arg)
+            if nm and any(nm.endswith(o) for o in _TIMEOUT_SOCKOPTS):
+                return True
+    return False
+
+
+class UnboundedSocketWaitRule(Rule):
+    """A12: blocking ZMQ recv/send with no Poller timeout and no
+    RCVTIMEO/SNDTIMEO.
+
+    A bare ``sock.recv()`` parks its thread until the peer speaks — and a
+    partitioned peer never does. Every wedge netchaos reproduces reduces
+    to exactly this shape: the wait has no bound, so neither the stop
+    flag nor the link-state machine is ever consulted again and the
+    thread is lost to the partition (docs/netchaos.md). A wire op must
+    either (a) run inside a Poller-guarded loop whose ``poll(timeout)``
+    bounds the wait, (b) pass ``zmq.NOBLOCK``/``DONTWAIT``, or (c) run on
+    a socket the file configures with ``RCVTIMEO``/``SNDTIMEO``. The
+    sanctioned exceptions are the lockstep env-server client loops —
+    parking in recv awaiting the action reply IS their protocol, and the
+    supervisor owns their lifetime — which carry suppressions saying so.
+    """
+
+    id = "A12"
+    name = "unbounded-socket-wait"
+    summary = "blocking ZMQ recv/send with no Poller timeout or RCVTIMEO/SNDTIMEO"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        file_timeout = _file_sets_socket_timeout(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute) or fn.attr not in _WIRE_OPS:
+                continue
+            if not _socket_ish(fn.value):
+                continue
+            if _has_noblock_flag(ctx, node):
+                continue
+            if _scope_has_bounded_poll(node):
+                continue
+            if file_timeout:
+                continue
+            yield ctx.finding(
+                self, node,
+                f"blocking .{fn.attr}() with no bound — a partitioned peer "
+                "parks this thread forever: guard it with a Poller "
+                "poll(timeout) loop, pass zmq.NOBLOCK, or set "
+                "RCVTIMEO/SNDTIMEO (docs/netchaos.md); lockstep env-server "
+                "clients suppress with the protocol justification",
+            )
+
+
 ACTOR_RULES = [
     BareThreadRule(),
     BlockingQueueOpRule(),
@@ -981,4 +1077,5 @@ ACTOR_RULES = [
     ServingHotPathBlockRule(),
     UnversionedParamsReadRule(),
     OrphanSpanRule(),
+    UnboundedSocketWaitRule(),
 ]
