@@ -136,13 +136,30 @@ class BlockStatesView:
     def __len__(self) -> int:
         return self.shape[0]
 
+    @property
+    def dtype(self):
+        return self.window.dtype
+
     def __array__(self, dtype=None, copy=None):
         hist = self.window.shape[0]
-        out = np.ascontiguousarray(self.window.transpose(1, 2, 3, 0))
+        # sanctioned materialization: __array__ IS the one copy a consumer
+        # that needs the whole block pays (the staged path calls
+        # materialize_into instead, so the bytes land in a reused buffer)
+        out = np.ascontiguousarray(self.window.transpose(1, 2, 3, 0))  # ba3clint: disable=A13
         for j in np.nonzero(self.ages < hist - 1)[0]:
             out[j, :, :, : hist - 1 - int(self.ages[j])] = 0
         if dtype is not None and dtype != out.dtype:
             out = out.astype(dtype)
+        return out
+
+    def materialize_into(self, out: np.ndarray) -> np.ndarray:
+        """The ``__array__`` interleave written into a PREALLOCATED buffer
+        (data/staging.py): zero allocations, one copy pass — the channel
+        interleave happens during the write into ``out``."""
+        hist = self.window.shape[0]
+        np.copyto(out, self.window.transpose(1, 2, 3, 0))
+        for j in np.nonzero(self.ages < hist - 1)[0]:
+            out[j, :, :, : hist - 1 - int(self.ages[j])] = 0
         return out
 
     def __getitem__(self, j: int) -> np.ndarray:
@@ -150,9 +167,58 @@ class BlockStatesView:
         age = int(self.ages[j])
         if age >= hist - 1:
             return self.window[:, j].transpose(1, 2, 0)  # zero-copy view
-        arr = np.ascontiguousarray(self.window[:, j].transpose(1, 2, 0))
+        # young env: the zeroed history planes need a (small) private copy
+        arr = np.ascontiguousarray(self.window[:, j].transpose(1, 2, 0))  # ba3clint: disable=A13
         arr[..., : hist - 1 - age] = 0
         return arr
+
+
+class SegStates:
+    """Lazy ``[T, H, W, hist]`` states of ONE env column over T block steps.
+
+    What a V-trace segment's ``"state"`` used to be was
+    ``np.stack([st.states[j] for st in seg])`` — a full obs copy paid on
+    the MASTER thread at every flush, before collate copied the same
+    bytes again. This wrapper defers that materialization to wherever the
+    bytes are actually consumed: ``materialize_into`` writes the column
+    straight into a staging stripe (data/staging.py — the ingest path's
+    ONE copy), ``__array__`` keeps every legacy consumer (the compat
+    collate's stack, the pod shipper's wire pack) byte-identical.
+
+    Ring-safety: holding per-step states (ring window views on the
+    block-shm wire) until collate is exactly what utils/shm.py's capacity
+    formula already budgets — a queued segment counts
+    ``ring_steps_per_item = unroll_len`` ring steps, which covers the
+    whole [s, s+T] span these references pin.
+    """
+
+    __slots__ = ("states", "j", "shape")
+
+    def __init__(self, states: list, j: int):
+        self.states = states  # T per-step [B, H, W, hist] state objects
+        self.j = int(j)
+        self.shape = (len(states), *tuple(np.shape(states[0]))[1:])
+
+    @property
+    def dtype(self):
+        return getattr(self.states[0], "dtype", np.dtype(np.uint8))
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    def __array__(self, dtype=None, copy=None):
+        out = np.stack([s[self.j] for s in self.states])  # ba3clint: disable=A13 — the compat materialization itself
+        if dtype is not None and dtype != out.dtype:
+            out = out.astype(dtype)
+        return out
+
+    def materialize_into(self, out: np.ndarray) -> np.ndarray:
+        """Write the env column into ``out[T, H, W, hist]`` (a staging
+        stripe view): one pass, no intermediate stack."""
+        j = self.j
+        for t, s in enumerate(self.states):
+            out[t] = s[j]
+        return out
 
 
 class BlockClientState:

@@ -23,6 +23,7 @@ import numpy as np
 from distributed_ba3c_tpu.actors.simulator import (
     BlockClientState,
     BlockStep,
+    SegStates,
     SimulatorMaster,
 )
 from distributed_ba3c_tpu.telemetry import tracing
@@ -178,7 +179,10 @@ class VTraceSimulatorMaster(SimulatorMaster):
             return
         seg, rest = client.memory[:T], client.memory[T:]
         segment = {
-            "state": np.stack([s.state for s in seg]),
+            # per-env compat foil: these states are per-simulator arrays
+            # (no ring window to defer into), so the stack stays — the
+            # staged collate still writes them once into the slot
+            "state": np.stack([s.state for s in seg]),  # ba3clint: disable=A13
             "action": np.asarray([s.action for s in seg], np.int32),
             "reward": np.asarray([s.reward for s in seg], np.float32),
             "done": np.asarray([s.done for s in seg], np.float32),
@@ -256,7 +260,10 @@ class VTraceSimulatorMaster(SimulatorMaster):
                 s = int(blk.start[j])
                 seg = blk.steps[s : s + T]
                 segment = {
-                    "state": np.stack([st.states[j] for st in seg]),
+                    # LAZY env column (SegStates): the flush no longer
+                    # pays a full obs copy per segment — the bytes cross
+                    # the host exactly once, at the (staged) collate
+                    "state": SegStates([st.states for st in seg], j),
                     "action": np.asarray(
                         [st.actions[j] for st in seg], np.int32
                     ),

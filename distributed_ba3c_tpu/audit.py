@@ -32,6 +32,12 @@ The registered entry points (one per hot-path jit site):
     predict.server        the batched action-server forward (predict/server.py)
     predict.server_greedy the greedy (eval/play) server variant — [3, B]
                           packed fetch (the duplicated argmax row dropped)
+    predict.server_bf16   the quantized serving forward: bf16 param storage
+                          (--rollout_dtype bfloat16), f32 heads — the
+                          cheaper program the actor plane serves from,
+                          structurally pinned so it cannot silently revert
+    fused.actor_bf16      the overlap rollout program at the bf16 params
+                          snapshot (fused.prep's cast output) — same pin
     pod.learner           the pod's bounded-staleness V-trace learner
                           (pod/learner.py) — the fused.learner gradient
                           body compiled standalone for host-fed blocks
@@ -645,6 +651,95 @@ def _build_predict_server() -> TraceTarget:
         donated_nonscalar_indices=[],
         # single-device serving path: any collective here means a mesh
         # sharding leaked into the action server
+        allow_collectives=False,
+    )
+
+
+def _bf16_params(params_avals):
+    """f32 param leaves → bf16 avals (what fused.prep's cast / the
+    predictor's publish-cast hands the rollout-side programs)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, jnp.bfloat16)
+        if l.dtype == jnp.float32 else l,
+        params_avals,
+    )
+
+
+@register_entry("predict.server_bf16")
+def _build_predict_server_bf16() -> TraceTarget:
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ba3c_tpu.predict.server import make_fwd_sample
+
+    cfg, model, opt = _canonical_parts()
+    params = _bf16_params(_state_avals(model, cfg, opt).params)
+    B = 16  # same canonical bucket as predict.server
+    states = jax.ShapeDtypeStruct((B, *cfg.state_shape), jnp.uint8)
+    return TraceTarget(
+        # the quantized serving/actor forward (--rollout_dtype bfloat16):
+        # same fwd_sample body, bf16 param STORAGE — a distinct compiled
+        # program whose halved param reads T5 pins separately (the f32
+        # entry must not silently absorb the cheap program's cost profile,
+        # nor vice versa); T1 still requires the bf16 conv stack and the
+        # log-prob heads stay f32 (models/a3c.py)
+        name="predict.server_bf16",
+        jit_fn=jax.jit(make_fwd_sample(model, greedy=False)),
+        args=(params, states, _key_aval()),
+        grad_shapes=None,
+        donated_nonscalar_indices=[],
+        allow_collectives=False,
+    )
+
+
+@register_entry("fused.actor_bf16")
+def _build_overlap_actor_bf16() -> TraceTarget:
+    import jax
+
+    from distributed_ba3c_tpu.envs.jaxenv import pong
+    from distributed_ba3c_tpu.fused.loop import create_fused_state
+    from distributed_ba3c_tpu.fused.overlap import ActorState, make_overlap_step
+
+    cfg, model, opt = _canonical_parts()
+    mesh = canonical_mesh()
+    n_envs = 2 * CANONICAL_MESH_DEVICES  # 2 envs per canonical shard
+    step = make_overlap_step(
+        model, opt, cfg, mesh, pong, rollout_len=4,
+        rollout_dtype="bfloat16",
+    )
+    state = jax.eval_shape(
+        lambda k: create_fused_state(
+            k, model, cfg, opt, pong, n_envs,
+            n_shards=CANONICAL_MESH_DEVICES,
+        ),
+        _key_aval(),
+    )
+    astate = ActorState(
+        env_state=state.env_state,
+        obs_stack=state.obs_stack,
+        key=state.key,
+        ep_return=state.ep_return,
+        ep_count=state.ep_count,
+        ep_return_sum=state.ep_return_sum,
+    )
+    params = _bf16_params(state.train.params)
+    return TraceTarget(
+        # the overlap rollout at the bf16 snapshot (fused.prep's cast):
+        # same donation-aliased env carry and collective-free contract as
+        # fused.actor, traced at the bf16 param avals the bf16 schedule
+        # actually feeds it — its halved param-read bytes get their own
+        # T5 row instead of hiding behind the f32 entry
+        name="fused.actor_bf16",
+        jit_fn=step.actor_jit,
+        args=(params, astate),
+        grad_shapes=None,
+        donated_nonscalar_indices=_donated_indices(
+            astate,
+            offset=len(jax.tree_util.tree_leaves(params)),
+        ),
         allow_collectives=False,
     )
 

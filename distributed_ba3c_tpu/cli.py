@@ -182,7 +182,8 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_to_keep", type=int, default=3, help="checkpoints retained (besides best); raise to keep every eval-epoch checkpoint for post-hoc crossing verification")
     p.add_argument("--steps_per_dispatch", type=int, default=1, help="fused trainer: wrap K update steps in one lax.scan program (one host dispatch per K updates; must divide --steps_per_epoch). Removes per-step dispatch overhead without relying on host pipelining. With --overlap, K actor/learner dispatch PAIRS per facade call instead")
     p.add_argument("--overlap", action="store_true", help="fused trainer: split the single fused program into two overlapped compiled programs — rollout k+1 runs concurrently with learner k (policy lag 1, V-trace-corrected; docs/overlap.md)")
-    p.add_argument("--rollout_dtype", default="float32", choices=["float32", "bfloat16"], help="--overlap only: dtype of the actor program's params snapshot. bfloat16 halves the rollout's param-read bandwidth; the heads stay f32 and V-trace clips the precision noise")
+    p.add_argument("--rollout_dtype", default="float32", choices=["float32", "bfloat16"], help="rollout/serving forward precision, END TO END (the learner always keeps f32): with --overlap it is the actor program's params-snapshot dtype; on the ZMQ trainers it is the BatchedPredictor's param storage (every policy publish casts on device). bfloat16 halves the forward's param-read bandwidth; the heads stay f32 and V-trace clips the precision noise. Audit-pinned as predict.server_bf16 / fused.actor_bf16")
+    p.add_argument("--ingest_staging", default="on", choices=["on", "off"], help="ZMQ trainers: zero-copy pinned-staging ingest (data/staging.py) — collate writes obs bytes straight into preallocated double-buffered staging arrays (ONE host copy per block, ingest_copies_total proves it) and the next batch's H2D dispatches behind the running step. off = the legacy materialize->collate->device_put chain (the plane_bench --ingest foil)")
     p.add_argument("--rank_stall_timeout", type=float, default=0, help="multi-host: seconds without proven progress (beats land after the dispatch-window metrics fetch, after eval, and after the collective save) before a rank declares a peer dead and exits 75 (0 = default 600s when multi-host; -1 disables the watchdog; the limit self-raises to 2x the slowest healthy window). Relaunch with --load to resume")
     p.add_argument("--seed", type=int, default=0, help="fused trainer: PRNG seed for params/envs/action sampling (whole-trajectory determinism per seed; multi-seed runs disclose seed selection in RESULTS.md)")
     p.add_argument("--tpu_lock", default="wait", choices=["wait", "fail", "off"], help="host-local TPU-claim mutex (utils/devicelock.py): wait = queue behind the current holder, fail = exit with the holder's pid/run, off = no guard. CPU-platform runs never take the lock")
@@ -612,6 +613,10 @@ def main(argv: Optional[list] = None) -> int:
             num_threads=cfg.predictor_threads,
             slo_ms=args.serve_slo_ms,
             tele_role=tele_role,
+            # the quantized rollout forward (--rollout_dtype bfloat16):
+            # serving-side param storage only — the learner publishes and
+            # keeps full precision (audit entry predict.server_bf16)
+            rollout_dtype=args.rollout_dtype,
         )
         # multi-policy serving (docs/serving.md): canary/shadow checkpoints
         # are pinned policies behind the same scheduler — the learner's
@@ -863,6 +868,19 @@ def main(argv: Optional[list] = None) -> int:
                 pl.fleet, pl.pipe_c2s, pl.pipe_s2c,
             )
     masters = [pl.master for pl in planes]
+    # the staged-ingest plane (docs/ingest.md): one HostStagingRing the
+    # feed's collate writes into (one host copy per block), wrapped by a
+    # DeviceIngest that dispatches the NEXT batch's H2D behind the
+    # running step (Trainer.run_step's prefetch call)
+    staging_on = args.ingest_staging == "on"
+    staging_ring = None
+    if staging_on:
+        from distributed_ba3c_tpu.data.staging import (
+            DeviceIngest,
+            HostStagingRing,
+        )
+
+        staging_ring = HostStagingRing()
     if multi_fleet:
         # fair round-robin merge of the per-fleet queues into stacked
         # [K, ...] macro batches (data/dataflow.py) — the layout the macro
@@ -875,14 +893,21 @@ def main(argv: Optional[list] = None) -> int:
                 if args.trainer == "tpu_vtrace_ba3c"
                 else collate_train
             ),
+            staging=staging_ring,
         )
         predictor = FanoutPredictors([pl.predictor for pl in planes])
     else:
         if args.trainer == "tpu_vtrace_ba3c":
-            feed = RolloutFeed(masters[0].queue, per_fleet_items)
+            feed = RolloutFeed(
+                masters[0].queue, per_fleet_items, staging=staging_ring
+            )
         else:
-            feed = TrainFeed(masters[0].queue, per_fleet_items)
+            feed = TrainFeed(
+                masters[0].queue, per_fleet_items, staging=staging_ring
+            )
         predictor = planes[0].predictor
+    if staging_on:
+        feed = DeviceIngest(feed, step.batch_sharding)
 
     # Order matters: Evaluator adds its stats BEFORE StatPrinter finalizes the
     # epoch record, and MaxSaver reads the monitored stat from that record.

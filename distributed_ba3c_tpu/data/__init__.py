@@ -2,9 +2,12 @@
 
 Reference equivalent: ``tensorpack/dataflow/`` + ``QueueInput`` (SURVEY.md
 §2.4 #11-12). The reference's generator-of-datapoints + TF FIFOQueue pipeline
-becomes: a bounded host queue filled by the master, a batcher thread stacking
-uint8 datapoints, and (in the trainer) async device_put against the mesh
-sharding so H2D overlaps compute.
+becomes: a bounded host queue filled by the master, a batcher thread whose
+collate writes uint8 datapoints IN PLACE into a pinned staging ring (one
+host copy per block, ``data/staging.py``), and a ``DeviceIngest`` pipeline
+that dispatches the next batch's H2D behind the running step
+(docs/ingest.md; the legacy stack-and-device_put chain survives as the
+measured compat foil).
 """
 
 from distributed_ba3c_tpu.data.dataflow import (
@@ -14,5 +17,19 @@ from distributed_ba3c_tpu.data.dataflow import (
     RolloutFeed,
     TrainFeed,
 )
+from distributed_ba3c_tpu.data.staging import (
+    BlockStager,
+    DeviceIngest,
+    HostStagingRing,
+)
 
-__all__ = ["BatchData", "DataFlow", "QueueDataFlow", "RolloutFeed", "TrainFeed"]
+__all__ = [
+    "BatchData",
+    "BlockStager",
+    "DataFlow",
+    "DeviceIngest",
+    "HostStagingRing",
+    "QueueDataFlow",
+    "RolloutFeed",
+    "TrainFeed",
+]

@@ -97,14 +97,23 @@ class _BatchFeed:
     overlap). Subclasses define :meth:`_collate`.
     """
 
+    #: staging dispatch key into data/staging.py COLLATE_INTO (subclasses)
+    _kind: str = ""
+
     def __init__(
         self,
         in_queue: "queue.Queue",
         batch_size: int,
         prefetch: int = 2,
+        staging=None,
     ):
         self.in_queue = in_queue
         self.batch_size = batch_size
+        #: data/staging.py HostStagingRing — when set, collate writes
+        #: in-place into an acquired slot (ONE obs copy) instead of
+        #: allocating fresh arrays per batch; slots recycle behind the
+        #: ring's H2D ready fence (docs/ingest.md)
+        self.staging = staging
         self._out: "queue.Queue[Dict[str, np.ndarray]]" = queue.Queue(
             maxsize=prefetch
         )
@@ -114,6 +123,24 @@ class _BatchFeed:
 
     def _collate(self, holder: List) -> Dict[str, np.ndarray]:
         raise NotImplementedError
+
+    def _collate_staged(self, holder: List, t: StoppableThread):
+        """Collate into a staging slot (None ONLY on stop — the ring's
+        backpressure mirrors the bounded out queue, so a stalled consumer
+        pauses the batcher here for as long as it takes, exactly like
+        ``queue_put_stoppable``; a transient device stall must never kill
+        the one batcher thread the trainer has)."""
+        from distributed_ba3c_tpu.data import staging as _staging
+
+        spec_fn, into_fn = _staging.COLLATE_INTO[self._kind]
+        slot = _staging.acquire_stoppable(
+            self.staging, spec_fn(holder), t.stopped
+        )
+        if slot is None:
+            return None
+        into_fn(holder, slot.buffers)
+        self.staging.count_staged_copy()
+        return self.staging.staged(slot)
 
     def start(self) -> None:
         self._thread.start()
@@ -144,12 +171,25 @@ class _BatchFeed:
             holder.append(item)
             if len(holder) < self.batch_size:
                 continue
-            batch = self._collate(holder)
+            if self.staging is not None:
+                batch = self._collate_staged(holder, t)
+                if batch is None:
+                    return  # stopped while every staging slot was fenced
+            else:
+                batch = self._collate(holder)
             holder = []
             if trace is not None:
-                batch["_trace"] = trace.hop("collate", "learner")
+                ref = trace.hop("collate", "learner")
+                # StagedBatch carries the ref as an attribute — device_put
+                # must never meet a TraceRef (train/trainer.py contract)
+                if self.staging is not None:
+                    batch.trace = ref
+                else:
+                    batch["_trace"] = ref
                 trace = None
             if not t.queue_put_stoppable(self._out, batch, timeout=0.2):
+                if self.staging is not None:
+                    batch.release()  # slot back in rotation for the join
                 return  # stopped while the learner was backed up
 
     def next_batch(self, timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
@@ -162,9 +202,18 @@ class _BatchFeed:
 def collate_train(holder: List[list]) -> Dict[str, np.ndarray]:
     """[state, action, R] datapoints → flat {state, action, return} arrays
     (THE collate both :class:`TrainFeed` and the multi-fleet merge use —
-    one definition, or the two streams' batch layouts could drift)."""
+    one definition, or the two streams' batch layouts could drift).
+
+    This is the COMPAT path: it allocates fresh arrays and pays one obs
+    stack pass per batch (self-reported to ``ingest_copies_total``); the
+    staged path (data/staging.py collate_train_into) writes the same
+    bytes once into a reused slot."""
+    from distributed_ba3c_tpu.data.staging import count_legacy_copies
+
+    count_legacy_copies(1.0)
     return {
-        "state": np.stack([dp[0] for dp in holder]),
+        # sanctioned compat copy — the staged collate is the budget path
+        "state": np.stack([dp[0] for dp in holder]),  # ba3clint: disable=A13
         "action": np.asarray([dp[1] for dp in holder], np.int32),
         "return": np.asarray([dp[2] for dp in holder], np.float32),
     }
@@ -176,15 +225,25 @@ def collate_rollout(holder: List[dict]) -> Dict[str, np.ndarray]:
     shipper, like collate_train). ``behavior_values`` rides along when the
     emitting master records it (pod/host.py PodSimulatorMaster — the
     ``value_lag_mae`` input); the V-trace planes' segments simply lack the
-    key and their batch layout is unchanged."""
+    key and their batch layout is unchanged.
+
+    COMPAT path, copy-accounted like :func:`collate_train`: the obs bytes
+    pay a coercion pass (lazy ``SegStates`` columns), a stack pass and
+    the time-major ``.copy()`` — 3 passes per batch vs the staged
+    collate's 1 (the ``plane_bench --ingest`` before/after evidence)."""
+    from distributed_ba3c_tpu.data.staging import count_legacy_copies
+
+    lazy = hasattr(holder[0]["state"], "materialize_into")
+    count_legacy_copies(3.0 if lazy else 2.0)
     batch = {}
     keys = ("state", "action", "reward", "done", "behavior_log_probs")
     if "behavior_values" in holder[0]:
         keys += ("behavior_values",)
     for k in keys:
-        stacked = np.stack([seg[k] for seg in holder], axis=0)  # [B,T,...]
-        batch[k] = np.swapaxes(stacked, 0, 1).copy()  # [T,B,...]
-    batch["bootstrap_state"] = np.stack(
+        # sanctioned compat copies — the staged collate is the budget path
+        stacked = np.stack([seg[k] for seg in holder], axis=0)  # ba3clint: disable=A13 — [B,T,...]
+        batch[k] = np.swapaxes(stacked, 0, 1).copy()  # ba3clint: disable=A13 — [T,B,...]
+    batch["bootstrap_state"] = np.stack(  # ba3clint: disable=A13
         [seg["bootstrap_state"] for seg in holder]
     )
     return batch
@@ -192,6 +251,8 @@ def collate_rollout(holder: List[dict]) -> Dict[str, np.ndarray]:
 
 class TrainFeed(_BatchFeed):
     """[state, action, R] datapoints → flat {state, action, return} batches."""
+
+    _kind = "train"
 
     def _collate(self, holder: List[list]) -> Dict[str, np.ndarray]:
         return collate_train(holder)
@@ -204,6 +265,8 @@ class RolloutFeed(_BatchFeed):
     batch axis and transposes time to the front (the reverse-scan layout of
     ops/vtrace.py).
     """
+
+    _kind = "rollout"
 
     def _collate(self, holder: List[dict]) -> Dict[str, np.ndarray]:
         return collate_rollout(holder)
@@ -249,6 +312,7 @@ class FleetMergeFeed:
         collate: "Callable[[List], Dict[str, np.ndarray]]" = collate_train,
         stacked: bool = True,
         prefetch: int = 2,
+        staging=None,
     ):
         if not queues:
             raise ValueError("FleetMergeFeed needs at least one fleet queue")
@@ -256,6 +320,11 @@ class FleetMergeFeed:
         self.batch_size = batch_size
         self.stacked = stacked
         self._collate_one = collate
+        #: staged macro collate: each fleet's sub-batch writes in-place
+        #: into its ``[k]`` stripe of one [K, ...] staging slot — the
+        #: per-sub collate AND the fleet stack collapse into one pass
+        self.staging = staging
+        self._kind = "rollout" if collate is collate_rollout else "train"
         self._out: "queue.Queue[Dict[str, np.ndarray]]" = queue.Queue(
             maxsize=prefetch
         )
@@ -307,28 +376,91 @@ class FleetMergeFeed:
                     flat.append(item)
                     rr = (k + 1) % K  # next pass starts past the last draw
                     if len(flat) == B:
-                        out = self._collate_one(flat)
+                        out = self._flat_collate(flat, t)
+                        if out is None:
+                            return  # stopped mid-staging-acquire
+                        flat = []
                         if trace is not None:
-                            out["_trace"] = trace.hop("collate", "learner")
+                            ref = trace.hop("collate", "learner")
+                            if self.staging is not None:
+                                out.trace = ref
+                            else:
+                                out["_trace"] = ref
                             trace = None
                         if not t.queue_put_stoppable(
                             self._out, out, timeout=0.2
                         ):
+                            if self.staging is not None:
+                                out.release()
                             return
-                        flat = []
             if self.stacked and all(len(h) == B for h in holders):
-                subs = [self._collate_one(h) for h in holders]
-                batch = {
-                    key: np.stack([s[key] for s in subs])
-                    for key in subs[0]
-                }
+                batch = self._stacked_collate(holders, t)
+                if batch is None:
+                    return  # stopped mid-staging-acquire
                 holders = [[] for _ in range(K)]
                 if trace is not None:
-                    batch["_trace"] = trace.hop("collate", "learner")
+                    ref = trace.hop("collate", "learner")
+                    if self.staging is not None:
+                        batch.trace = ref
+                    else:
+                        batch["_trace"] = ref
                     trace = None
                 if not t.queue_put_stoppable(self._out, batch, timeout=0.2):
+                    if self.staging is not None:
+                        batch.release()
                     return
             if not drew:
                 # every queue empty (or banked full): bounded sleep-poll,
                 # the FastQueue idiom — never a condvar wait on K queues
                 time.sleep(self._POLL_S)
+
+    def _flat_collate(self, flat: list, t: StoppableThread):
+        """One interleaved batch (``stacked=False``) — staged when a ring
+        is attached, the shared collate otherwise."""
+        if self.staging is None:
+            return self._collate_one(flat)
+        from distributed_ba3c_tpu.data import staging as _staging
+
+        spec_fn, into_fn = _staging.COLLATE_INTO[self._kind]
+        slot = _staging.acquire_stoppable(
+            self.staging, spec_fn(flat), t.stopped
+        )
+        if slot is None:
+            return None
+        into_fn(flat, slot.buffers)
+        self.staging.count_staged_copy()
+        return self.staging.staged(slot)
+
+    def _stacked_collate(self, holders: List[list], t: StoppableThread):
+        """One [K, ...] macro batch. Staged mode collapses the per-fleet
+        collate AND the fleet-axis stack into one pass: each sub-batch
+        writes in-place into its ``[k]`` stripe of the slot."""
+        if self.staging is None:
+            from distributed_ba3c_tpu.data.staging import count_legacy_copies
+
+            subs = [self._collate_one(h) for h in holders]
+            # the fleet-axis stack is one MORE pass over bytes the K
+            # sub-collates already counted as K blocks — report the pass
+            # without a new block so the legacy ratio stays > 1
+            count_legacy_copies(1.0, blocks=0)
+            return {
+                # sanctioned compat copy: the fleet-axis stack (the staged
+                # macro collate writes stripes in place instead)
+                key: np.stack([s[key] for s in subs])  # ba3clint: disable=A13
+                for key in subs[0]
+            }
+        from distributed_ba3c_tpu.data import staging as _staging
+
+        spec_fn, into_fn = _staging.COLLATE_INTO[self._kind]
+        sub_spec = spec_fn(holders[0])
+        spec = {
+            key: ((len(holders), *shape), dtype)
+            for key, (shape, dtype) in sub_spec.items()
+        }
+        slot = _staging.acquire_stoppable(self.staging, spec, t.stopped)
+        if slot is None:
+            return None
+        for k, h in enumerate(holders):
+            into_fn(h, {key: buf[k] for key, buf in slot.buffers.items()})
+        self.staging.count_staged_copy()
+        return self.staging.staged(slot)

@@ -232,12 +232,22 @@ class PodLearnerPlane:
             jax.random.PRNGKey(seed), model, cfg, optimizer
         )
         self.publisher = ParamsPublisher(self.endpoints)
-        self.ingest = PodIngest(self.endpoints, depth=ingest_depth)
         self.learner = PodLearner(
             step, state, cfg,
             publisher=self.publisher,
             max_staleness=max_staleness,
             publish_every=publish_every,
+            # every buffered StampedBatch holds a stager slot: the ring
+            # must cover the ingest depth (+ one staging, one in-flight)
+            # or a backed-up learner degrades to per-block fresh
+            # allocations — the cost the stager exists to remove
+            stager_slots=ingest_depth + 2,
+        )
+        # the learner's own BlockStager on the ingest receive thread: the
+        # wire→staging copy overlaps the learner step, and the learner
+        # loop only pays the async device transfer (docs/ingest.md)
+        self.ingest = PodIngest(
+            self.endpoints, depth=ingest_depth, stager=self.learner.stager
         )
 
     def start(self) -> None:

@@ -271,6 +271,14 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--predict_batch_size", type=int, default=16)
     p.add_argument("--reward_clip", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--rollout_dtype", default="float32",
+        choices=["float32", "bfloat16"],
+        help="the host predictor's param-storage precision (the cached "
+        "params arrive f32 from the learner and are cast at publish; "
+        "audit entry predict.server_bf16) — the actor-host half of the "
+        "quantized rollout forward",
+    )
     return p
 
 
@@ -328,6 +336,7 @@ def main(argv: Optional[list] = None) -> int:
         batch_size=cfg.predict_batch_size,
         seed=args.seed + 1000 * args.host_id,
         tele_role="predictor",
+        rollout_dtype=args.rollout_dtype,
     )
     predictor.warmup(cfg.state_shape)
     cache.on_update(lambda params, version: predictor.update_params(params))

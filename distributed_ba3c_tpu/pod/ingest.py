@@ -44,6 +44,8 @@ class StampedBatch:
 
     host: int
     version: int  # params version the block was COLLECTED under
+    #: zero-copy wire views, or a data/staging.py StagedBlock when the
+    #: ingest pre-stages on its receive thread (PodLearner handles both)
     batch: Dict[str, np.ndarray]
     #: publisher lifetime the version counts within (0 = unknown/legacy);
     #: the learner rejects blocks from a lineage it does not own
@@ -67,8 +69,16 @@ class PodIngest:
         endpoints: PodEndpoints,
         depth: int = 16,
         tele_role: str = "learner",
+        stager=None,
     ):
         self.endpoints = endpoints
+        #: data/staging.py BlockStager (pass the consuming PodLearner's
+        #: own ``stager``): when set, the wire→staging copy happens HERE,
+        #: on the receive thread, so it overlaps the learner's step — the
+        #: learner only pays the (async) device_put. When None the
+        #: StampedBatch carries the zero-copy wire views and the learner
+        #: stages on its own thread. Either way: one host copy per block.
+        self.stager = stager
         self.context = zmq.Context()
         self._pull = self.context.socket(zmq.PULL)
         self._pull.setsockopt(zmq.LINGER, 0)
@@ -200,10 +210,21 @@ class PodIngest:
             )
             if out is not None:
                 trace = tracing.TraceRef(*out)
+            if self.stager is not None:
+                # the ONE host copy, paid on THIS thread: wire views →
+                # reused staging buffers while the learner's step runs
+                # (the zmq frames are released here instead of pinned in
+                # the buffer until consumption)
+                batch = self.stager.copy_in(batch)
             with self._ready:
                 if len(self._buf) >= self._depth:
-                    self._buf.popleft()
+                    dropped = self._buf.popleft()
                     self._c_dropped.inc()
+                    if self.stager is not None:
+                        # a shed block's staging slot goes straight back
+                        # in rotation — a busy slot held by a dropped
+                        # batch would starve the ring
+                        self.stager.cancel(dropped.batch)
                 self._buf.append(
                     StampedBatch(host, version, batch, epoch, trace)
                 )

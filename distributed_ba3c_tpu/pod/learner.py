@@ -135,19 +135,30 @@ def batch_to_block(
     """Host [T, B] experience batch (pod/wire.py EXPERIENCE_KEYS layout) →
     a device TrajBlock. Dtypes are coerced here, in one place: the wire
     ships whatever the collate produced, the program's input contract
-    lives with the program."""
+    lives with the program.
+
+    COMPAT path: seven fresh allocations per block. The consuming loop
+    (:meth:`PodLearner.consume`) stages through a
+    :class:`~distributed_ba3c_tpu.data.staging.BlockStager` instead —
+    one copy into a REUSED per-shape buffer, ready-fenced against the
+    in-flight H2D — so this stays for one-shot callers only."""
+    from distributed_ba3c_tpu.data.staging import count_legacy_copies
+
+    count_legacy_copies(1.0)
     leaves = TrajBlock(
-        states=np.ascontiguousarray(batch["state"], np.uint8),
-        actions=np.ascontiguousarray(batch["action"], np.int32),
-        rewards=np.ascontiguousarray(batch["reward"], np.float32),
-        dones=np.ascontiguousarray(batch["done"], np.float32),
-        behavior_log_probs=np.ascontiguousarray(
+        # sanctioned compat copies — PodLearner's BlockStager is the
+        # budget path (reused buffers, same dtype coercion)
+        states=np.ascontiguousarray(batch["state"], np.uint8),  # ba3clint: disable=A13
+        actions=np.ascontiguousarray(batch["action"], np.int32),  # ba3clint: disable=A13
+        rewards=np.ascontiguousarray(batch["reward"], np.float32),  # ba3clint: disable=A13
+        dones=np.ascontiguousarray(batch["done"], np.float32),  # ba3clint: disable=A13
+        behavior_log_probs=np.ascontiguousarray(  # ba3clint: disable=A13
             batch["behavior_log_probs"], np.float32
         ),
-        behavior_values=np.ascontiguousarray(
+        behavior_values=np.ascontiguousarray(  # ba3clint: disable=A13
             batch["behavior_values"], np.float32
         ),
-        bootstrap_state=np.ascontiguousarray(
+        bootstrap_state=np.ascontiguousarray(  # ba3clint: disable=A13
             batch["bootstrap_state"], np.uint8
         ),
     )
@@ -220,6 +231,7 @@ class PodLearner:
         max_staleness: Optional[int] = None,
         publish_every: int = 1,
         tele_role: str = "learner",
+        stager_slots: int = 4,
     ):
         self.step = step
         self.state = jax.device_put(state, step.state_sharding)
@@ -245,6 +257,18 @@ class PodLearner:
         self.entropy_beta = cfg.entropy_beta
         self.learning_rate = cfg.learning_rate
         self.version = 0
+        # staged ingest (data/staging.py): ONE copy per block into a
+        # reused per-shape buffer replaces batch_to_block's seven fresh
+        # ascontiguousarray allocations; hand this same stager to
+        # PodIngest so the wire→staging write runs on the receive thread,
+        # overlapping the learner's step (docs/ingest.md). When wired
+        # into an ingest, ``stager_slots`` must cover the ingest DEPTH
+        # (every buffered StampedBatch holds a slot) or the backlogged
+        # regime degrades to per-block transient allocations — the very
+        # cost the stager removes (orchestrate/pod.py sizes it depth+2)
+        from distributed_ba3c_tpu.data.staging import BlockStager
+
+        self.stager = BlockStager(slots=stager_slots, tele_role=tele_role)
         self.gate = StalenessGate(max_staleness, tele_role=tele_role)
         self._tele_role = tele_role
         tele = telemetry.registry(tele_role)
@@ -300,6 +324,7 @@ class PodLearner:
                     "epoch_gate", self._tele_role,
                     tags={"rejected": True, "reason": "epoch_mismatch"},
                 )
+            self._release_staged(stamped)
             return None
         lag = self.gate.admit(stamped.version, self.version, stamped.host)
         if lag is None:
@@ -310,18 +335,38 @@ class PodLearner:
                     "staleness_gate", self._tele_role,
                     tags={"rejected": True, "lag": "over_bound"},
                 )
+            self._release_staged(stamped)
             return None
         if ref is not None:
             ref = ref.hop(
                 "staleness_gate", self._tele_role, tags={"lag": lag}
             )
-        block = batch_to_block(stamped.batch, self.step.block_sharding)
+        block = self._stage_block(stamped)
         if ref is not None:
             ref = ref.hop("pod_ingest_stage", self._tele_role)
         out = self._update(block)
         if ref is not None:
             ref.hop("pod_learner_step", self._tele_role)
         return out
+
+    def _stage_block(self, stamped) -> TrajBlock:
+        """Admitted block → device TrajBlock through the staging path: the
+        wire views (or a receive-thread pre-staged block, pod/ingest.py)
+        cross the host exactly once."""
+        from distributed_ba3c_tpu.data.staging import StagedBlock
+
+        staged = stamped.batch
+        if not isinstance(staged, StagedBlock):
+            staged = self.stager.copy_in(staged)
+        return self.stager.to_device(staged, self.step.block_sharding)
+
+    def _release_staged(self, stamped) -> None:
+        """A rejected block's receive-thread staging slot must go back in
+        rotation without a transfer."""
+        from distributed_ba3c_tpu.data.staging import StagedBlock
+
+        if isinstance(stamped.batch, StagedBlock):
+            self.stager.cancel(stamped.batch)
 
     def consume_block(self, block: TrajBlock, block_version: int,
                       host: Optional[int] = None) -> Optional[dict]:

@@ -67,6 +67,73 @@ def _next_pow2(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
+#: serving/actor forward precisions (the learner always keeps f32 — this
+#: only selects the PARAMS precision of the serving program, the overlap
+#: prep-cast extended to the ZMQ serving plane; models/a3c.py keeps the
+#: policy/value heads f32 either way)
+ROLLOUT_DTYPES = ("float32", "bfloat16")
+
+
+class _StagePool:
+    """Reused per-shape serving staging buffers with the H2D ready fence.
+
+    ``_launch`` materializes each group ONCE into a pooled buffer (lazy
+    block-states views interleave straight in, padding included) instead
+    of paying a fresh ``np.asarray`` + pad ``np.concatenate`` per
+    dispatch. A buffer goes back to its free list when the dispatches
+    that read it are fetched; an UNFETCHED release (the no-tap shadow
+    mirror, which must never add a host sync) parks on the pending list
+    until its output handle reports ready — reusing the host bytes while
+    a transfer may still be reading them is the read-after-donate hazard
+    (data/staging.py's fence, serving edition)."""
+
+    __slots__ = ("_free", "_pending", "_c_alloc", "_c_copies")
+
+    def __init__(self, tele):
+        self._free: dict = {}     # (shape, dtype str) -> [ndarray, ...]
+        self._pending: list = []  # (handle, key, ndarray) awaiting ready
+        self._c_alloc = tele.counter("stage_alloc_total")
+        self._c_copies = tele.counter("stage_copies_total")
+
+    def _drain(self) -> None:
+        still = []
+        for handle, key, arr in self._pending:
+            if getattr(handle, "is_ready", lambda: True)():
+                self._free.setdefault(key, []).append(arr)
+            else:
+                still.append((handle, key, arr))
+        self._pending = still
+
+    def acquire(self, shape: tuple, dtype) -> np.ndarray:
+        self._drain()
+        key = (tuple(shape), np.dtype(dtype).str)
+        free = self._free.get(key)
+        if free:
+            return free.pop()
+        self._c_alloc.inc()
+        return np.zeros(shape, dtype)
+
+    def release(self, arr: np.ndarray, handle=None) -> None:
+        key = (tuple(arr.shape), arr.dtype.str)
+        if handle is None or getattr(handle, "is_ready", lambda: True)():
+            self._free.setdefault(key, []).append(arr)
+        else:
+            self._pending.append((handle, key, arr))
+
+    def count_copy(self) -> None:
+        self._c_copies.inc()
+
+
+class _StageLease:
+    """One staged group buffer shared by its primary + shadow dispatches."""
+
+    __slots__ = ("arr", "refs")
+
+    def __init__(self, arr: np.ndarray, refs: int = 1):
+        self.arr = arr
+        self.refs = refs
+
+
 class ShedReject:
     """Typed reject delivered to a task's ``shed_callback``.
 
@@ -141,10 +208,10 @@ class _Inflight:
     """One dispatched-not-yet-fetched device call the scheduler tracks."""
 
     __slots__ = ("tasks", "n", "policy", "handle", "t_dispatch", "t_oldest",
-                 "shadow", "states", "t_dispatch_us")
+                 "shadow", "states", "t_dispatch_us", "lease")
 
     def __init__(self, tasks, n, policy, handle, t_dispatch, t_oldest=0.0,
-                 shadow=False, states=None, t_dispatch_us=0):
+                 shadow=False, states=None, t_dispatch_us=0, lease=None):
         self.tasks = tasks        # ordered singles-then-blocks; None = shadow
         self.n = n
         self.policy = policy
@@ -159,6 +226,9 @@ class _Inflight:
         # µs dispatch stamp for trace spans (0 when no task is traced —
         # the untraced path never reads the clock for it)
         self.t_dispatch_us = t_dispatch_us
+        # _StageLease of the pooled staging buffer this call reads (None
+        # for pass-through / sync-path batches); released at _complete
+        self.lease = lease
 
 
 def make_fwd_sample(model, greedy: bool = False) -> Callable:
@@ -238,12 +308,35 @@ class BatchedPredictor:
         dispatch_depth: int = 2,
         clock: Optional[Callable[[], float]] = None,
         tele_role: str = "predictor",
+        rollout_dtype: str = "float32",
     ):
         import time as _time
 
         self._model = model
         self.num_actions = int(getattr(model, "num_actions", 0) or 0)
-        self._policies = {"default": jax.device_put(params)}
+        if rollout_dtype not in ROLLOUT_DTYPES:
+            raise ValueError(
+                f"rollout_dtype must be one of {ROLLOUT_DTYPES}, got "
+                f"{rollout_dtype!r}"
+            )
+        self.rollout_dtype = rollout_dtype
+        if rollout_dtype == "bfloat16":
+            # the overlap split's prep-cast, serving edition: every policy
+            # publish casts f32 params to bf16 ON DEVICE (one small pass,
+            # amortized over a whole publish interval), halving the
+            # forward's param-read bandwidth; the heads stay f32 compute
+            # (models/a3c.py) so log mu(a|s) keeps its precision and
+            # V-trace clips whatever noise the storage cast adds
+            self._cast_params = jax.jit(
+                lambda p: jax.tree_util.tree_map(
+                    lambda x: x.astype(jnp.bfloat16)
+                    if x.dtype == jnp.float32 else x,
+                    p,
+                )
+            )
+        else:
+            self._cast_params = None
+        self._policies = {"default": self._put_policy(params)}
         self._batch_size = batch_size
         self._coalesce_s = coalesce_ms / 1000.0
         self._slo_s = slo_ms / 1000.0
@@ -326,14 +419,22 @@ class BatchedPredictor:
             fn=lambda: p._inflight_n if (p := ref()) else 0,
         )
 
+        # the serving staging pool (docs/ingest.md): one materialization
+        # per dispatched group into a reused buffer, ready-fenced
+        self._pool = _StagePool(tele)
+
         # registered audit entry point (distributed_ba3c_tpu/audit.py).
         # auto_arm=False: the pow-2 bucket warmup is a LEGITIMATE multi-shape
         # compile sequence; warmup() arms the tripwire when it completes, so
         # only a new bucket size appearing mid-serving raises. Continuous
         # batching keeps this contract: every group is padded to a warmed
-        # bucket before dispatch.
+        # bucket before dispatch. The bf16 variant is its own entry point
+        # (predict.server_bf16): a different program, its own T1/T2/T5 pin.
+        entry = "predict.server_greedy" if greedy else "predict.server"
+        if rollout_dtype == "bfloat16":
+            entry += "_bf16"
         self._fwd = tripwire_jit(
-            "predict.server_greedy" if greedy else "predict.server",
+            entry,
             make_fwd_sample(model, greedy),
             auto_arm=False,
         )
@@ -377,6 +478,15 @@ class BatchedPredictor:
                 t.join(timeout)
 
     # -- policy table ------------------------------------------------------
+    def _put_policy(self, params):
+        """Params → the serving table's storage: device-resident, cast to
+        the rollout dtype (bf16 mode) — ONE place, so every publish path
+        (ctor, add_policy, update_params) serves the same precision."""
+        p = jax.device_put(params)
+        if self._cast_params is not None:
+            p = self._cast_params(p)
+        return p
+
     def add_policy(self, policy_id: str, params) -> None:
         """Make a second checkpoint hot behind the same scheduler.
 
@@ -387,7 +497,7 @@ class BatchedPredictor:
                 f"policy id {policy_id!r} must match {_POLICY_ID_RE.pattern} "
                 "(it names Prometheus series)"
             )
-        self._policies[policy_id] = jax.device_put(params)
+        self._policies[policy_id] = self._put_policy(params)
         self._c_policy_rows.setdefault(
             policy_id, self._tele.counter(f"policy_{policy_id}_rows_total")
         )
@@ -428,6 +538,10 @@ class BatchedPredictor:
         keeps serving its stale weights."""
         if policy not in self._policies:
             raise KeyError(f"unknown policy {policy!r} — add_policy first")
+        if self._cast_params is not None:
+            # learner publishes stay full precision; the CAST is the
+            # serving table's own storage step (atomic swap after)
+            params = self._cast_params(params)
         self._policies[policy] = params
         self._c_publishes.inc()
 
@@ -755,6 +869,57 @@ class BatchedPredictor:
             weight += tk.k
         return tasks, weight, first.policy
 
+    def _stage_group(self, singles, blocks, weight):
+        """The group's ONE materialization: rows interleave straight into
+        a pooled, bucket-padded staging buffer (lazy block-states views
+        included — data/staging.py's write-into discipline replaces the
+        old np.asarray-then-concatenate chain at this site). Returns
+        ``(batch, lease)``; lease None = zero-copy pass-through (a lone
+        already-bucket-shaped ndarray block, served AS-IS like before).
+        Pad rows keep stale bytes: only rows :weight reach any callback,
+        so zeroing them every reuse would be a copy with no reader."""
+        padded = _next_pow2(max(weight, 1))
+        if not singles and len(blocks) == 1:
+            b0 = blocks[0].states
+            if isinstance(b0, np.ndarray) and b0.shape[0] == padded:
+                return b0, None
+        if singles:
+            first = singles[0].states
+            tail = tuple(np.shape(first))  # one row's shape
+        else:
+            first = blocks[0].states
+            tail = tuple(np.shape(first))[1:]  # strip the block axis
+        dtype = getattr(first, "dtype", np.uint8)
+        buf = self._pool.acquire((padded, *tail), dtype)
+        off = 0
+        for tk in singles:
+            buf[off] = tk.states
+            off += 1
+        for tk in blocks:
+            dest = buf[off : off + tk.k]
+            mi = getattr(tk.states, "materialize_into", None)
+            if mi is not None:
+                mi(dest)
+            else:
+                dest[...] = tk.states
+            off += tk.k
+        self._pool.count_copy()
+        return buf, _StageLease(buf)
+
+    def _release_lease(self, inf: _Inflight, synced: bool) -> None:
+        """One dispatch done with its staging buffer; the buffer frees
+        when every sharer (primary + shadow) released. ``synced=False``
+        (the unfetched shadow) parks on the ready fence instead — the
+        host bytes may still be feeding the transfer."""
+        lease = inf.lease
+        if lease is None:
+            return
+        lease.refs -= 1
+        if lease.refs == 0:
+            self._pool.release(
+                lease.arr, None if synced else inf.handle[1]
+            )
+
     def _launch(self, group) -> List[_Inflight]:
         """Dispatch one group (plus its shadow mirror) — no host fetch."""
         tasks, weight, policy = group
@@ -762,15 +927,7 @@ class BatchedPredictor:
             policy = self._route_group(weight)  # un-pinned: routed here
         singles = [tk for tk in tasks if isinstance(tk, _RowTask)]
         blocks = [tk for tk in tasks if isinstance(tk, _BlockTask)]
-        rows = []
-        if singles:
-            rows.append(np.stack([tk.states for tk in singles]))
-        rows.extend(b.states for b in blocks)
-        # a lone block is served AS-IS (its states stay a zero-copy view
-        # straight off the wire); mixing tasks pays one concat
-        batch = rows[0] if len(rows) == 1 else np.concatenate(
-            [np.asarray(r) for r in rows]
-        )
+        batch, lease = self._stage_group(singles, blocks, weight)
         now = self._clock()
         # counted at LAUNCH (not fetch) so the series lead the latency
         # histograms by exactly the in-flight window
@@ -792,17 +949,20 @@ class BatchedPredictor:
         )
         out = [_Inflight(
             ordered, weight, policy, handle, now,
-            t_oldest=t_oldest, t_dispatch_us=t_us,
+            t_oldest=t_oldest, t_dispatch_us=t_us, lease=lease,
         )]
         shadow = self._shadow
         if shadow is not None:
             self._c_shadow_batches.inc()
             self._c_shadow_rows.inc(weight)
+            if lease is not None:
+                lease.refs += 1  # the mirror reads the same staged bytes
             out.append(_Inflight(
                 None, weight, shadow,
                 self._dispatch(self._policies[shadow], batch), now,
                 shadow=True,
                 states=batch if self.shadow_tap is not None else None,
+                lease=lease,
             ))
         return out
 
@@ -822,11 +982,22 @@ class BatchedPredictor:
             # installed — a tap that appears mid-flight skips this call
             if tap is not None and inf.states is not None:
                 actions, _, _, _ = self._collect(inf.handle)
-                self._fire(tap, np.asarray(inf.states), actions, inf.policy)
-            # no tap: DROP without a host sync — shadow evaluation must
-            # never add fetch latency to the serving path
+                states = np.asarray(inf.states)[: inf.n]
+                if inf.lease is not None:
+                    # the tap's states must outlive the staging buffer's
+                    # reuse (pad rows are sliced off above for the same
+                    # reason: the tap sees exactly the SERVED rows)
+                    states = states.copy()  # ba3clint: disable=A13 — eval tap, not the ingest path
+                self._fire(tap, states, actions[: inf.n], inf.policy)
+                self._release_lease(inf, synced=True)
+            else:
+                # no tap: DROP without a host sync — shadow evaluation
+                # must never add fetch latency to the serving path; the
+                # staging buffer parks on the ready fence instead
+                self._release_lease(inf, synced=False)
             return
         actions, values, logps, _ = self._collect(inf.handle)
+        self._release_lease(inf, synced=True)
         now = self._clock()
         if inf.t_dispatch_us:
             # sampled spans: dispatch wait (admit -> device dispatch) and

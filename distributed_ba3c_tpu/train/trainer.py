@@ -141,6 +141,15 @@ class Trainer:
         return jax.device_put(v, sharding)
 
     def _next_device_batch(self):
+        if getattr(self.feed, "is_device_ingest", False):
+            # staged pipeline (data/staging.py DeviceIngest): the batch's
+            # H2D was dispatched behind the PREVIOUS step (run_step's
+            # prefetch call) whenever the feed kept up — the claim here is
+            # then just a handoff, and the ingest/h2d_copy hops were
+            # already recorded by the pipeline
+            batch = self.feed.next_batch(timeout=self.config.feed_timeout)
+            self._pending_trace = batch.pop("_trace", None)
+            return batch
         batch = self.feed.next_batch(timeout=self.config.feed_timeout)
         # a sampled trace rode the batch through the feed (tracing.py):
         # claim it before staging — device_put must never see the ref
@@ -191,6 +200,15 @@ class Trainer:
                 self.hyperparams["learning_rate"],
             )
         self.global_step += 1
+        prefetch = getattr(self.feed, "prefetch", None)
+        if prefetch is not None:
+            # staged pipeline: dispatch the NEXT batch's H2D right behind
+            # the step dispatch above, so the transfer overlaps the
+            # device's execution of THIS step. Non-blocking by contract —
+            # the shutdown-starvation and lost-accounting failure modes
+            # that reverted the old post-step staging fetch (see the
+            # Overlap note above) were properties of a BLOCKING fetch
+            prefetch()
         if self._pending_trace is not None:
             # host-side dispatch of the update (device execution is async;
             # a chip-session jax.profiler capture correlates via the

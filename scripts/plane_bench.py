@@ -210,6 +210,246 @@ def run_trace_capture(
     return capture, failures
 
 
+def run_ingest_phase(
+    game: str = "pong",
+    n_envs: int = 64,
+    unroll_len: int = 5,
+    feed_batch: int = 4,
+    steps_per_arm: int = 40,
+    sample: int = 4,
+    timeout_s: float = 240.0,
+):
+    """The ingest before/after: legacy materialize→collate→device_put vs
+    the staged pipeline (data/staging.py), SAME SESSION, device-free.
+
+    Both arms run the full block-shm plane (C++ env server → master →
+    null predictor → unroll flush → RolloutFeed) into the REAL jitted
+    CPU V-trace learner; what differs is ONLY the ingest chain:
+
+    - ``legacy``: plain RolloutFeed (compat collate: coerce + stack +
+      time-major copy = 3 obs passes/batch) + per-key ``device_put`` at
+      the head of the step — the measured ingest hop is that put chain.
+    - ``staged``: RolloutFeed writing into a HostStagingRing (ONE obs
+      pass/batch) wrapped in DeviceIngest — the H2D for batch k+1 is
+      dispatched right after step k (prefetch), so the measured ingest
+      hop is just the claim of already-dispatched device arrays.
+
+    Gates (ISSUE 14 acceptance): staged copies-per-block == exactly 1.0
+    (``ingest_copies_total / ingest_blocks_total``), and the staged
+    median ingest hop ≥ 20% below the legacy median. Returns
+    ``(row, gate_failures)``; the row embeds both arms' per-hop
+    histograms and the master's e2e series as evidence.
+    """
+    import queue
+    import statistics as _stats
+    import tempfile
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from distributed_ba3c_tpu import telemetry
+    from distributed_ba3c_tpu.telemetry import tracing
+    from distributed_ba3c_tpu.actors.vtrace_master import VTraceSimulatorMaster
+    from distributed_ba3c_tpu.config import BA3CConfig
+    from distributed_ba3c_tpu.data.dataflow import RolloutFeed
+    from distributed_ba3c_tpu.data.staging import DeviceIngest, HostStagingRing
+    from distributed_ba3c_tpu.envs import native
+    from distributed_ba3c_tpu.models.a3c import BA3CNet
+    from distributed_ba3c_tpu.ops.gradproc import make_optimizer
+    from distributed_ba3c_tpu.parallel.mesh import make_mesh
+    from distributed_ba3c_tpu.parallel.train_step import create_train_state
+    from distributed_ba3c_tpu.parallel.vtrace_step import make_vtrace_train_step
+
+    from bench import make_null_predictor
+    from distributed_ba3c_tpu.utils.devicelock import stderr_print
+
+    n_actions = native.CppBatchedEnv(game, 1).num_actions
+    cfg = BA3CConfig(num_actions=n_actions, predict_batch_size=max(64, n_envs))
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, *cfg.state_shape), np.uint8)
+    )["params"]
+    mesh = make_mesh(num_model=1)
+    opt = make_optimizer(
+        cfg.learning_rate, cfg.adam_epsilon, cfg.grad_clip_norm
+    )
+    step_fn = make_vtrace_train_step(model, opt, cfg, mesh)
+
+    def scalars():
+        return telemetry.registry("learner").scalars()
+
+    def arm(staged: bool) -> dict:
+        telemetry.reset_all()
+        telemetry.set_enabled(True)
+        tracing.set_sampling(sample)
+        os.environ["BA3C_TRACE"] = str(sample)
+        state = jax.device_put(
+            create_train_state(jax.random.PRNGKey(0), model, cfg, opt),
+            step_fn.state_sharding,
+        )
+        tmp = tempfile.mkdtemp(prefix="ba3c-ingest-")
+        c2s, s2c = f"ipc://{tmp}/c2s", f"ipc://{tmp}/s2c"
+        predictor = make_null_predictor(
+            model, params, n_actions,
+            batch_size=max(64, n_envs), coalesce_ms=0.0,
+        )
+        master = VTraceSimulatorMaster(
+            c2s, s2c, predictor, unroll_len=unroll_len,
+            train_queue=queue.Queue(maxsize=256),
+        )
+        master.feed_batch = feed_batch
+        ring = HostStagingRing() if staged else None
+        feed = RolloutFeed(master.queue, batch_size=feed_batch, staging=ring)
+        ingest = (
+            DeviceIngest(feed, step_fn.batch_sharding) if staged else None
+        )
+        proc = native.CppEnvServerProcess(  # ba3clint: disable=A8 — raw plane is the measurand, like run_trace_capture
+            0, c2s, s2c, game=game, n_envs=n_envs, wire="block-shm",
+        )
+        ingest_s = []
+        steps = 0
+        try:
+            predictor.start()
+            master.start()
+            feed.start()
+            proc.start()
+            deadline = _time.monotonic() + timeout_s
+            while steps < steps_per_arm and _time.monotonic() < deadline:
+                if staged:
+                    # wait for work WITHOUT timing the actor plane: the
+                    # measurand is the step-path ingest hop, not feed wait
+                    while (
+                        not ingest.prefetch()
+                        and _time.monotonic() < deadline
+                    ):
+                        _time.sleep(0.002)
+                    t0 = _time.perf_counter()
+                    try:
+                        batch = ingest.next_batch(timeout=10)
+                    except queue.Empty:
+                        continue  # starved: the steps gate reports it
+                    ingest_s.append(_time.perf_counter() - t0)
+                    ref = batch.pop("_trace", None)
+                else:
+                    try:
+                        batch = feed.next_batch(timeout=10)
+                    except queue.Empty:
+                        continue
+                    ref = batch.pop("_trace", None)
+                    t0 = _time.perf_counter()
+                    batch = {
+                        k: jax.device_put(v, step_fn.batch_sharding[k])
+                        for k, v in batch.items()
+                    }
+                    ingest_s.append(_time.perf_counter() - t0)
+                    if ref is not None:
+                        ref = ref.hop("ingest", "learner")
+                state, _m = step_fn(
+                    state, batch, cfg.entropy_beta, cfg.learning_rate
+                )
+                steps += 1
+                if ref is not None:
+                    ref.hop("learner_step", "learner")
+        finally:
+            proc.terminate()
+            if ingest is not None:
+                ingest.stop()
+            else:
+                feed.stop()
+            master.close()
+            predictor.stop()
+            predictor.join(timeout=5)
+            feed.join(timeout=2)
+        learner = scalars()
+        hop_hists = {
+            f"{role}/{name}": m
+            for role, series in telemetry.all_snapshots().items()
+            for name, m in series.items()
+            if name.startswith(("hop_", "e2e_ingest", "staging_wait"))
+        }
+        copies = learner.get("ingest_copies_total", 0.0)
+        blocks = learner.get("ingest_blocks_total", 0.0)
+        row = {
+            "staged": staged,
+            "learner_steps": steps,
+            "median_ingest_s": (
+                _stats.median(ingest_s) if ingest_s else None
+            ),
+            "p90_ingest_s": (
+                sorted(ingest_s)[int(0.9 * (len(ingest_s) - 1))]
+                if ingest_s else None
+            ),
+            "ingest_copies_total": copies,
+            "ingest_blocks_total": blocks,
+            "copies_per_block": (
+                round(copies / blocks, 4) if blocks else None
+            ),
+            "prefetched": learner.get("ingest_prefetched_total", 0.0),
+            "dispatch_now": learner.get("ingest_dispatch_now_total", 0.0),
+            "staging_waits": learner.get("staging_waits_total", 0.0),
+            "hop_histograms": hop_hists,
+        }
+        stderr_print(
+            f"ingest arm {'staged' if staged else 'legacy'}: "
+            f"{steps} steps, median ingest "
+            f"{(row['median_ingest_s'] or 0) * 1e6:.0f} us, "
+            f"copies/block {row['copies_per_block']}"
+        )
+        return row
+
+    failures = []
+    legacy = arm(staged=False)
+    staged = arm(staged=True)
+    telemetry.reset_all()
+    row = {
+        "game": game, "n_envs": n_envs, "unroll_len": unroll_len,
+        "feed_batch": feed_batch, "wire": "block-shm",
+        "trace_sample": sample,
+        # this container has no reachable accelerator: the H2D here is
+        # the CPU PJRT transfer (de-aliased, data/staging.py) — the
+        # on-chip re-capture stays on ROADMAP item 1's list
+        "device_free_proxy": True,
+        "legacy": legacy,
+        "staged": staged,
+    }
+    if staged["learner_steps"] < steps_per_arm // 2 or legacy[
+        "learner_steps"
+    ] < steps_per_arm // 2:
+        failures.append(
+            "ingest phase FAILED: an arm starved before half its steps "
+            f"(legacy {legacy['learner_steps']}, staged "
+            f"{staged['learner_steps']} of {steps_per_arm})"
+        )
+        return row, failures
+    if staged["copies_per_block"] != 1.0:
+        failures.append(
+            "ingest copy gate FAILED: staged copies-per-block = "
+            f"{staged['copies_per_block']} (must be exactly 1.0 — "
+            "shm bytes -> staging write, nothing else)"
+        )
+    if legacy["copies_per_block"] is None or legacy["copies_per_block"] <= 1.0:
+        failures.append(
+            "ingest foil broken: legacy copies-per-block = "
+            f"{legacy['copies_per_block']} (expected > 1 — the before "
+            "arm no longer measures the chain the staging replaced)"
+        )
+    ratio = (
+        staged["median_ingest_s"] / legacy["median_ingest_s"]
+        if legacy["median_ingest_s"] else None
+    )
+    row["staged_over_legacy_ingest"] = (
+        round(ratio, 4) if ratio is not None else None
+    )
+    if ratio is None or ratio > 0.8:
+        failures.append(
+            "ingest latency gate FAILED: staged median ingest is "
+            f"{ratio if ratio is None else round(ratio, 3)}x the legacy "
+            "median (gate: <= 0.8x, i.e. >= 20% improvement same-session)"
+        )
+    return row, failures
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--game", default="pong")
@@ -296,6 +536,19 @@ def main() -> int:
     ap.add_argument(
         "--trace_sample", type=int, default=64,
         help="1-in-N block sampling rate for the --trace arms",
+    )
+    ap.add_argument(
+        "--ingest", action="store_true",
+        help="ALSO run the staged-ingest before/after (data/staging.py): "
+        "legacy materialize->collate->device_put vs the pinned staging "
+        "ring + async H2D pipeline, same session through a REAL CPU "
+        "V-trace learner. Gates: staged host copies-per-block == 1 "
+        "exactly (ingest_copies_total) and staged median ingest hop "
+        ">= 20%% below legacy (docs/ingest.md)",
+    )
+    ap.add_argument(
+        "--ingest_steps", type=int, default=40,
+        help="learner steps per --ingest arm",
     )
     args = ap.parse_args()
 
@@ -541,6 +794,19 @@ def main() -> int:
         out["trace"] = capture.pop("document")
         out["trace_capture"] = capture
         gate_failures.extend(cap_failures)
+    if args.ingest:
+        # the staged-ingest before/after: copies-per-block + the ingest
+        # hop collapse, measured same-session (ISSUE-14 acceptance;
+        # committed as runs/plane_bench_r15.json)
+        ingest_row, ingest_failures = run_ingest_phase(
+            game=args.game,
+            # one env server drives the rig: its block B is the smaller
+            # of the fleet flags (the same flags every other phase obeys)
+            n_envs=min(args.n_envs, args.envs_per_proc),
+            steps_per_arm=args.ingest_steps,
+        )
+        out["ingest"] = ingest_row
+        gate_failures.extend(ingest_failures)
     if fleet_scaling:
         # the multi-fleet scaling gate's evidence: single vs aggregate at
         # equal per-fleet shape, same session (ISSUE-10 acceptance)

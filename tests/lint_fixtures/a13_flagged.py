@@ -1,0 +1,24 @@
+"""A13 flagged fixture: the pre-staging ingest chain's copy shapes."""
+import numpy as np
+
+
+def collate_batch(holder):
+    # fresh stack on the ingest path: the staging write is the budget
+    batch = {"state": np.stack([dp[0] for dp in holder])}
+    batch["state_t"] = np.swapaxes(batch["state"], 0, 1).copy()
+    return batch
+
+
+def _on_block_flush(steps, j):
+    # per-segment materialization at emit time — the SegStates lesson
+    return np.stack([st[j] for st in steps])
+
+
+def batch_to_block(batch):
+    # fresh contiguous copy per block instead of a reused staging buffer
+    return np.ascontiguousarray(batch["state"], np.uint8)
+
+
+def unrelated_helper(rows):
+    # NOT on the ingest path (no scope fragment in the name): quiet
+    return np.stack(rows)

@@ -264,6 +264,7 @@ def test_iter_eqns_descends_into_scan():
 def test_registry_names():
     assert audit_mod.entry_names() == [
         "fused.actor",
+        "fused.actor_bf16",
         "fused.greedy_eval",
         "fused.learner",
         "fused.macro_learner",
@@ -274,6 +275,7 @@ def test_registry_names():
         "parallel.vtrace_step",
         "pod.learner",
         "predict.server",
+        "predict.server_bf16",
         "predict.server_greedy",
     ]
 

@@ -1065,6 +1065,92 @@ class UnboundedSocketWaitRule(Rule):
             )
 
 
+#: function-name fragments that mark a def as the train-ingest path: the
+#: collates, the masters' flush/emit sites, the pod's block staging, and
+#: the lazy views' materializations — where obs bytes move between the
+#: wire/ring and the learner's staging
+_INGEST_FN_FRAGMENTS = (
+    "collate", "flush", "emit", "ingest", "to_block", "__array__",
+    "stage_group",
+)
+
+#: the copy constructors the staging discipline replaces
+_COPY_CALLS = {"numpy.stack", "numpy.ascontiguousarray", "numpy.concatenate"}
+
+#: the ONE module allowed to copy obs bytes on the ingest path
+_STAGING_MODULE = "data/staging.py"
+
+
+class IngestExtraCopyRule(Rule):
+    """A13: ``np.stack``/``np.ascontiguousarray``/``.copy()`` on the
+    train-ingest path outside ``data/staging.py``.
+
+    The ingest copy budget (docs/ingest.md) is ONE host pass per block:
+    shm-ring/wire bytes → the staging write; ``plane_bench --ingest``
+    gates ``ingest_copies_total / ingest_blocks_total == 1`` on it. A
+    fresh stack/contiguous-copy/`.copy()` inside a collate, flush/emit,
+    or block-staging function re-grows exactly the materialize→stack→
+    transpose chain the staging subsystem retired — every byte it copies
+    is a second pass the budget no longer accounts for. Route the bytes
+    through the in-place collates (``collate_*_into``) / the stagers, or
+    suppress with the justification for why this site is sanctioned (the
+    per-env compat foil's stack, the legacy collate fallbacks, and the
+    lazy views' ``__array__`` compat materializations carry exactly such
+    suppressions). The rule scopes to functions whose names mark the
+    ingest path — copies elsewhere are someone else's budget.
+    """
+
+    id = "A13"
+    name = "ingest-extra-copy"
+    summary = "obs-byte copy (stack/ascontiguousarray/.copy) on the train-ingest path outside data/staging.py"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        path = ctx.path.replace(os.sep, "/")
+        if path.endswith(_STAGING_MODULE):
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            low = fn.name.lower()
+            if not any(f in low for f in _INGEST_FN_FRAGMENTS):
+                continue
+            yield from self._check_fn(ctx, fn)
+
+    def _check_fn(self, ctx: FileContext, fn: ast.AST) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            # nested defs get their own scope decision (a non-ingest
+            # closure inside a flush fn is still the flush path; keep it)
+            resolved = ctx.info.resolve(node.func)
+            if resolved in _COPY_CALLS:
+                short = resolved.rsplit(".", 1)[-1]
+                yield ctx.finding(
+                    self, node,
+                    f"np.{short} on the train-ingest path — the copy "
+                    "budget is ONE staging write per block "
+                    "(data/staging.py collate_*_into / BlockStager); a "
+                    "sanctioned compat copy needs a suppression saying "
+                    "why (docs/ingest.md)",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "copy"
+                and not node.args
+                and not node.keywords
+                and isinstance(node.func.value, (ast.Call, ast.Subscript))
+            ):
+                # array-expression .copy() (np.swapaxes(...).copy(),
+                # arr[...].copy()) — dict/list .copy() on plain names
+                # stays out of scope
+                yield ctx.finding(
+                    self, node,
+                    ".copy() of an array expression on the train-ingest "
+                    "path — write into the staging slot instead "
+                    "(collate_*_into), or suppress with the sanction",
+                )
+
+
 ACTOR_RULES = [
     BareThreadRule(),
     BlockingQueueOpRule(),
@@ -1078,4 +1164,5 @@ ACTOR_RULES = [
     UnversionedParamsReadRule(),
     OrphanSpanRule(),
     UnboundedSocketWaitRule(),
+    IngestExtraCopyRule(),
 ]
