@@ -508,7 +508,7 @@ def bench_zmq_plane(
                 tele_role=telemetry.fleet_role("predictor", tag),
             )
         else:
-            predictor = BatchedPredictor(
+            predictor = BatchedPredictor(  # ba3clint: disable=A14 — the RAW single plane is the measurand here (the routed plane has its own instrument, serving_bench --replicas)
                 model, params, batch_size=predict_bs, num_threads=2,
                 coalesce_ms=coalesce_ms,
                 tele_role=telemetry.fleet_role("predictor", tag),

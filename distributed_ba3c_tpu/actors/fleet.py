@@ -34,6 +34,7 @@ import re
 from typing import Any, Callable, List, Optional, Tuple
 
 from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.utils import logger
 
 _TCP_RE = re.compile(r"^(tcp://[^:]+:)(\d+)$")
 
@@ -66,29 +67,98 @@ def fleet_pipes(pipe_c2s: str, pipe_s2c: str, fleet: int) -> Tuple[str, str]:
 class FanoutPredictors:
     """The learner-side facade over K per-fleet predictors.
 
-    ``update_params`` fans the publish out to every fleet (each predictor
-    device_puts its own copy, so no fleet ever reads another's donated
-    buffers); synchronous reads (``predict_batch`` — the Evaluator path)
-    delegate to fleet 0, whose policy is identical after any publish.
-    Lifecycle stays with the per-fleet startables — this facade owns no
-    threads.
+    ``update_params`` fans the publish out to every fleet WITHOUT
+    blocking the caller: one latest-wins pump thread per predictor
+    (utils/concurrency.py :class:`LatestWinsPump`), so a slow or wedged
+    fleet's predictor stalls only its own pump — never the learner's
+    publish path, and never the OTHER fleets' publishes. Skipped
+    intermediate versions are correct by construction (latest wins per
+    policy: nobody should ever serve a version the learner has already
+    superseded) and counted as ``fanout_publishes_coalesced_total``.
+    Synchronous reads (``predict_batch`` — the Evaluator path) delegate
+    to fleet 0, whose policy is identical after any settled publish.
+    ``flush()`` is the barrier for callers that need settledness (tests,
+    checkpoint-restore republish); ``close()`` stops the pumps.
     """
 
     def __init__(self, predictors: List[Any]):
         if not predictors:
             raise ValueError("FanoutPredictors needs at least one predictor")
         self.predictors = list(predictors)
+        from distributed_ba3c_tpu.utils.concurrency import LatestWinsPump
+
+        tele = telemetry.registry("learner")
+        self._c_publishes = tele.counter("fanout_publishes_total")
+        self._c_coalesced = tele.counter("fanout_publishes_coalesced_total")
+        self._c_errors = tele.counter("fanout_publish_errors_total")
+        # fan-out facade, not a new publish path: the ONE sanctioned
+        # caller (Trainer._publish_params) owns the version accounting;
+        # the pumps only multiply its publish across fleets
+        self._pumps = [
+            LatestWinsPump(
+                apply=lambda policy, params, _p=pred: _p.update_params(  # ba3clint: disable=A10
+                    params, policy=policy
+                ),
+                name=f"param-fanout-{k}",
+                on_coalesce=self._c_coalesced.inc,
+                on_error=lambda e, _k=k: self._publish_error(_k, e),
+            )
+            for k, pred in enumerate(self.predictors)
+        ]
+        for p in self._pumps:
+            p.start()
+
+    def _publish_error(self, fleet: int, e: Exception) -> None:
+        # a failing publish means this fleet's actors keep sampling a
+        # FROZEN policy — counted, flight-recorded AND logged, so the
+        # async pump never turns the old synchronous loud-failure path
+        # into a silent one
+        self._c_errors.inc()
+        telemetry.flight_recorder().record(
+            "fanout_publish_error", fleet=fleet, error=repr(e)
+        )
+        logger.error(
+            "param fan-out to fleet %d predictor FAILED (its actors are "
+            "sampling a stale policy until a publish succeeds): %r",
+            fleet, e,
+        )
 
     @property
     def num_actions(self) -> int:
         return self.predictors[0].num_actions
 
     def update_params(self, params, policy: str = "default") -> None:
-        # fan-out facade, not a new publish path: the ONE sanctioned
-        # caller (Trainer._publish_params) owns the version accounting;
-        # this loop only multiplies its publish across fleets
-        for pred in self.predictors:
-            pred.update_params(params, policy=policy)  # ba3clint: disable=A10
+        for pump in self._pumps:
+            pump.publish(policy, params)
+        self._c_publishes.inc()
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Wait until every fleet applied the latest publish (False if a
+        predictor stayed wedged past ``timeout`` — the caller keeps its
+        thread either way; that is the whole point of the pumps)."""
+        ok = True
+        for pump in self._pumps:
+            ok = pump.flush(timeout) and ok
+        return ok
+
+    # StartProcOrThread protocol: the facade owns pump THREADS now, so it
+    # must ride the trainer lifecycle (cli puts it first in startables:
+    # the pumps stop before any predictor they publish into does)
+    def start(self) -> None:
+        """No-op: the pumps spin up in ``__init__`` so pre-train
+        publishes (checkpoint restore) already fan out."""
+
+    def stop(self) -> None:
+        for pump in self._pumps:
+            pump.stop()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        for pump in self._pumps:
+            pump.join(timeout)
+
+    def close(self) -> None:
+        for pump in self._pumps:
+            pump.stop()
 
     def predict_batch(self, states):
         return self.predictors[0].predict_batch(states)

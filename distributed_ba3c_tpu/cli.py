@@ -174,6 +174,32 @@ def make_parser() -> argparse.ArgumentParser:
         "served batch, results dropped before any caller — pure "
         "observability (tele/predictor/shadow_* series)",
     )
+    p.add_argument(
+        "--serve_replicas", type=int, default=1,
+        help="serve each fleet's predict traffic from R replicated "
+        "serving planes behind the SLO router (predict/router.py): "
+        "least-loaded dispatch with deadline-aware overflow, per-replica "
+        "health from their telemetry series, typed re-shed of a dead "
+        "replica's traffic. 1 = the single PR-9 plane, unchanged",
+    )
+    p.add_argument(
+        "--serve_replicas_max", type=int, default=0,
+        help="enable the serving autoscaler up to this replica bound "
+        "(requires --serve_slo_ms; grows from the --serve_replicas base, "
+        "routing the plane even at a base of 1): replicas are "
+        "added on served-p99/shed-rate SLO pressure and retired on "
+        "slack, every decision flight-recorded (orchestrate/serving.py). "
+        "0 = fixed replica count",
+    )
+    p.add_argument(
+        "--canary_autopromote", action="store_true",
+        help="hand the --canary_load candidate to the PromotionController "
+        "(requires --serve_replicas > 1, --serve_slo_ms and --fleets 1): "
+        "auto-ROLLBACK on canary SLO breach is armed from live "
+        "latency/shed evidence; reward-based auto-PROMOTION additionally "
+        "needs a reward feed (PromotionController.observe_reward — see "
+        "docs/serving.md). Off = the canary split is static, as before",
+    )
     p.add_argument("--profiler_port", type=int, default=0, help="start jax.profiler server on this port (0=off)")
     p.add_argument("--telemetry_port", type=int, default=0, help="serve the telemetry scrape endpoint on this port (0=off): /metrics Prometheus text, /json raw snapshots, /flight the live flight-recorder ring, /trace the span buffer (docs/observability.md)")
     p.add_argument("--trace_sample", type=int, default=0, help="trace 1 in N block steps through the distributed trace plane (0=off): sampled causal spans env-step->learner-step with per-hop hop_<name>_s histograms, scraped at /trace and rendered by scripts/trace_dump.py (docs/observability.md)")
@@ -349,16 +375,54 @@ def main(argv: Optional[list] = None) -> int:
     # serving-plane flags belong to the predictor path; a fused run has no
     # predictor, and a half-specified canary is a config typo — usage
     # errors, never silently-ignored modifiers (repo convention)
-    serving_flags = args.serve_slo_ms or args.canary_load or args.shadow_load
+    serving_flags = (
+        args.serve_slo_ms or args.canary_load or args.shadow_load
+        or args.serve_replicas > 1 or args.serve_replicas_max
+    )
     if serving_flags and (
         args.task != "train" or args.trainer == "tpu_fused_ba3c"
     ):
         raise SystemExit(
-            "--serve_slo_ms/--canary_load/--shadow_load configure the "
-            "BatchedPredictor serving plane — they apply to the ZMQ-plane "
-            "trainers' train task only (the fused trainer serves actions "
-            "inside its compiled program; eval/play are synchronous)"
+            "--serve_slo_ms/--canary_load/--shadow_load/--serve_replicas "
+            "configure the predictor serving plane — they apply to the "
+            "ZMQ-plane trainers' train task only (the fused trainer "
+            "serves actions inside its compiled program; eval/play are "
+            "synchronous)"
         )
+    if args.serve_replicas < 1:
+        raise SystemExit(
+            f"--serve_replicas must be >= 1, got {args.serve_replicas}"
+        )
+    if args.serve_replicas_max:
+        if args.serve_replicas_max < args.serve_replicas:
+            raise SystemExit(
+                f"--serve_replicas_max {args.serve_replicas_max} < "
+                f"--serve_replicas {args.serve_replicas}"
+            )
+        if not args.serve_slo_ms:
+            raise SystemExit(
+                "--serve_replicas_max autoscales on the serving SLO — it "
+                "requires --serve_slo_ms (the watermark is served-p99 "
+                "against that budget)"
+            )
+    if args.canary_autopromote:
+        if not args.canary_load:
+            raise SystemExit(
+                "--canary_autopromote needs --canary_load (the candidate "
+                "checkpoint to canary)"
+            )
+        if args.serve_replicas < 2 or not args.serve_slo_ms:
+            raise SystemExit(
+                "--canary_autopromote runs on the serving ROUTER — it "
+                "requires --serve_replicas >= 2 and --serve_slo_ms (the "
+                "breach budget)"
+            )
+        if args.fleets > 1:
+            raise SystemExit(
+                "--canary_autopromote decides per router; with --fleets N "
+                "there are N independent routers and one canary decision "
+                "must not be made N times — run it single-fleet"
+            )
     if bool(args.canary_load) != bool(args.canary_fraction > 0):
         raise SystemExit(
             "--canary_load and --canary_fraction come together: the "
@@ -605,32 +669,105 @@ def main(argv: Optional[list] = None) -> int:
                 ("shadow", _policy_params(args.shadow_load), None)
             )
 
-    def make_predictor(k: int, tele_role: str):
-        pred = BatchedPredictor(
+    def _build_replica(tele_role_r: str):
+        # THE sanctioned serving factory: handed to the fleet assembly
+        # (and to the ReplicaSet under --serve_replicas), lifecycle owned
+        # by cli's startables / the router's owned ReplicaSet
+        return BatchedPredictor(  # ba3clint: disable=A14 — the sanctioned fleet-assembly factory
             model,
             state.params,
             batch_size=cfg.predict_batch_size,
             num_threads=cfg.predictor_threads,
             slo_ms=args.serve_slo_ms,
-            tele_role=tele_role,
+            tele_role=tele_role_r,
             # the quantized rollout forward (--rollout_dtype bfloat16):
             # serving-side param storage only — the learner publishes and
             # keeps full precision (audit entry predict.server_bf16)
             rollout_dtype=args.rollout_dtype,
         )
-        # multi-policy serving (docs/serving.md): canary/shadow checkpoints
-        # are pinned policies behind the same scheduler — the learner's
-        # update_params publishes only touch 'default'
+
+    # extra serving-plane startables grown by the routed path (the
+    # per-fleet ReplicaAutoscaler, the fleet-0 PromotionController)
+    serving_extras = []
+
+    def make_predictor(k: int, tele_role: str):
+        R = args.serve_replicas
+        # --serve_replicas_max above the base count forces the ROUTED
+        # plane even at R == 1: the autoscaler needs a router/ReplicaSet
+        # to grow into, so the modifier is honored, never silently dropped
+        routed = R > 1 or bool(
+            args.serve_replicas_max and args.serve_replicas_max > R
+        )
+        if not routed:
+            pred = _build_replica(tele_role)
+            # multi-policy serving (docs/serving.md): canary/shadow
+            # checkpoints are pinned policies behind the one scheduler —
+            # the learner's update_params publishes only touch 'default'
+            for name, params_k, fraction in _policy_extras:
+                pred.add_policy(name, params_k)
+                if name == "canary":
+                    pred.set_canary("canary", fraction)
+                else:
+                    pred.set_shadow("shadow")
+            # precompile every serving bucket now — a first-time bucket
+            # compile mid-training stalls the whole actor plane
+            pred.warmup(cfg.state_shape)
+            return pred
+        # the ROUTED plane (ISSUE 15, docs/serving.md): R replicas behind
+        # the SLO router; the master holds "a predictor" either way
+        from distributed_ba3c_tpu.orchestrate.serving import (
+            PromotionController,
+            ReplicaAutoscaler,
+            ReplicaSet,
+            ServingScalerPolicy,
+        )
+        from distributed_ba3c_tpu.predict.router import (
+            ServingRouter,
+            replica_role,
+        )
+
+        router = ServingRouter(
+            tele_role=tele_role.replace("predictor", "router")
+        )
+        rs = ReplicaSet(
+            router,
+            factory=lambda idx: _build_replica(replica_role(tele_role, idx)),
+            min_replicas=R,
+            max_replicas=max(R, args.serve_replicas_max or R),
+            warm=lambda p: p.warmup(cfg.state_shape),
+        )
+        rs.start(R)
+        # ONE startable handle for the whole routed plane: router.stop()
+        # closes its owned ReplicaSet (replicas included)
+        router.replica_set = rs
+        # policies live at ROUTER level so autoscale-grown replicas are
+        # seeded with the same table before they take traffic
         for name, params_k, fraction in _policy_extras:
-            pred.add_policy(name, params_k)
+            if name == "canary" and args.canary_autopromote:
+                continue  # the PromotionController owns the canary below
+            router.add_policy(name, params_k)
             if name == "canary":
-                pred.set_canary("canary", fraction)
+                router.set_canary("canary", fraction)
             else:
-                pred.set_shadow("shadow")
-        # precompile every serving bucket now — a first-time bucket compile
-        # mid-training stalls the whole actor plane for tens of seconds
-        pred.warmup(cfg.state_shape)
-        return pred
+                router.set_shadow("shadow")
+        if args.serve_replicas_max and args.serve_replicas_max > R:
+            serving_extras.append(ReplicaAutoscaler(
+                rs,
+                ServingScalerPolicy(slo_ms=args.serve_slo_ms),
+                interval_s=args.autoscale_interval,
+            ))
+        if args.canary_autopromote and k == 0:
+            ctrl = PromotionController(
+                router,
+                fraction=args.canary_fraction,
+                slo_ms=args.serve_slo_ms,
+            )
+            canary_params = next(
+                p for n, p, _ in _policy_extras if n == "canary"
+            )
+            ctrl.start_canary(canary_params)
+            serving_extras.append(ctrl)
+        return router
 
     if args.trainer == "tpu_vtrace_ba3c":
         # segments per fleet sub-batch: ~batch_size transitions. Single
@@ -935,10 +1072,18 @@ def main(argv: Optional[list] = None) -> int:
     # then supervisors/autoscalers (spawning servers before their master's
     # receive loop is live would park the whole fleet in its first recv)
     startables = [pl.predictor for pl in planes]
+    if multi_fleet:
+        # the fan-out facade owns pump threads: it rides the same
+        # lifecycle, FIRST so its pumps stop before any predictor they
+        # publish into does (start() is a no-op — pumps run from ctor)
+        startables.insert(0, predictor)
     startables += masters
     startables.append(feed)
     startables += [pl.supervisor for pl in planes if pl.supervisor is not None]
     startables += [pl.autoscaler for pl in planes if pl.autoscaler is not None]
+    # the routed serving plane's control loops (--serve_replicas_max
+    # autoscaler, --canary_autopromote controller) ride the same lifecycle
+    startables += serving_extras
     callbacks = [
         StartProcOrThread(startables + tele_servers),
         HumanHyperParamSetter("learning_rate", shared_dir=base_logdir),
@@ -1021,7 +1166,9 @@ def _run_eval(args, cfg, model, state) -> int:
     if args.load:
         mgr = CheckpointManager(args.load)
         state = mgr.restore(jax.device_get(state))
-    predictor = BatchedPredictor(
+    # synchronous single-user eval tooling, not the serving tier: only
+    # predict_batch is ever called, no routed traffic exists to bypass
+    predictor = BatchedPredictor(  # ba3clint: disable=A14 — sync eval tool, predict_batch only
         model, state.params, batch_size=max(args.nr_eval, 1), greedy=True
     )
     build_player = _build_player_factory(args, cfg)
@@ -1050,7 +1197,7 @@ def _run_play(args, cfg, model, state) -> int:
     if args.load:
         mgr = CheckpointManager(args.load)
         state = mgr.restore(jax.device_get(state))
-    predictor = BatchedPredictor(model, state.params, batch_size=1, greedy=True)
+    predictor = BatchedPredictor(model, state.params, batch_size=1, greedy=True)  # ba3clint: disable=A14 — sync play tool, predict_batch only
     build_player = _build_player_factory(args, cfg)
 
     for ep in range(max(args.nr_eval, 1)):

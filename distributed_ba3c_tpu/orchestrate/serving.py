@@ -1,0 +1,691 @@
+"""Serving orchestration: replica lifecycle, SLO autoscaling, canary loop.
+
+The serving router (predict/router.py) only ROUTES; this module is the
+control plane above it, built the way every orchestration layer here is
+(docs/orchestration.md): pure decision functions driven by telemetry
+signals, every decision flight-recorded WITH the input snapshot that
+caused it, all lifecycle owned by one supervisor-shaped component.
+
+Three pieces:
+
+- :class:`ReplicaSet` — spawns/retires predictor replicas from a
+  pluggable factory (real ``BatchedPredictor``s, bench null devices, test
+  fakes all ride the same lifecycle), registers them with the router
+  under monotonic incarnation ids (``r0, r1, …`` — a respawn is a NEW
+  replica, so its telemetry series never merge with a corpse's), and
+  clamps ``scale_to`` to the configured bounds.
+- :class:`ServingScalerPolicy` + :class:`ReplicaAutoscaler` — the PR-7
+  ``AutoscalerPolicy`` shape (bang-bang, watermark deadband, patience,
+  cooldown) generalized to the serving SLO: the watermark is the routed
+  plane's WINDOWED served-p99 and shed-rate (router.aggregate_signals),
+  not queue fill — and the sign flips: backpressure on the actor fleet
+  means RETIRE servers, an SLO breach on the serving fleet means ADD
+  replicas.
+- :class:`PromotionController` — closes the canary loop: watches
+  per-policy reward and latency series (rewards via ``observe_reward``,
+  latency/sheds via the router's exact per-request tap), auto-PROMOTES
+  the canary to default on a statistical win (Welch z over the reward
+  windows), and auto-ROLLS-BACK on an SLO breach or a statistical loss.
+  Both decisions land in the flight recorder with the full input
+  snapshot — a promotion in a postmortem always comes with the evidence
+  that justified it.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.utils import logger
+from distributed_ba3c_tpu.utils.concurrency import StoppableThread
+
+
+class ReplicaSet:
+    """Owns the serving replicas' lifecycle behind one router.
+
+    ``factory(idx)`` returns an UNSTARTED predictor for incarnation
+    ``idx`` (the factory picks its telemetry role —
+    ``predict.router.replica_role`` is the convention); ``warm(pred)``
+    optionally precompiles its buckets before it takes traffic;
+    ``signals(idx, pred)`` optionally overrides the health source (the
+    cross-process http scrape). Replica ids are monotonic (``r<idx>``,
+    never reused): a respawned replica must not inherit a corpse's
+    telemetry series or outstanding accounting.
+    """
+
+    def __init__(
+        self,
+        router,
+        factory: Callable[[int], object],
+        min_replicas: int = 1,
+        max_replicas: int = 8,
+        warm: Optional[Callable[[object], None]] = None,
+        signals: Optional[Callable[[int, object], Callable]] = None,
+        retire_grace_s: float = 5.0,
+    ):
+        if not 1 <= min_replicas <= max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{min_replicas}/{max_replicas}"
+            )
+        self.router = router
+        self._factory = factory
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self._warm = warm
+        self._signals = signals
+        self.retire_grace_s = retire_grace_s
+        self._lock = threading.Lock()
+        self._next_idx = 0
+        self._closed = False
+        self._live: List[str] = []  # replica ids, spawn order
+        self._flight = telemetry.flight_recorder()
+        tele = telemetry.registry("orchestrator")
+        self._c_spawns = tele.counter("serving_replica_spawns_total")
+        self._c_retires = tele.counter("serving_replica_retires_total")
+        self._c_replacements = tele.counter(
+            "serving_replica_replacements_total"
+        )
+        self._c_up = tele.counter("serving_scale_up_total")
+        self._c_down = tele.counter("serving_scale_down_total")
+        # the corpse sweeper: a DEAD replica (router health verdict) is
+        # removed from the set and REPLACED by a fresh incarnation, so a
+        # fixed-count deployment heals to its target without an
+        # autoscaler in the loop
+        self._reconcile_thread = StoppableThread(
+            target=self._reconcile_loop, daemon=True,
+            name="ReplicaSet-reconcile",
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, n: Optional[int] = None) -> None:
+        """Spawn the initial replicas (default: ``min_replicas``) and
+        start the dead-replica reconcile loop."""
+        n = self.min_replicas if n is None else n
+        n = max(self.min_replicas, min(self.max_replicas, n))
+        for _ in range(n):
+            self._spawn()
+        self._reconcile_thread.start()
+
+    def close(self) -> None:
+        """Stop every replica (teardown; queued tasks get the typed
+        ``shutdown`` reject, in-flight dispatches complete). Sets the
+        closed flag FIRST so a scale-up tick racing teardown cannot
+        register a replica nothing will ever stop."""
+        with self._lock:
+            self._closed = True
+        self._reconcile_thread.stop()
+        if self._reconcile_thread.is_alive():
+            self._reconcile_thread.join(timeout=5)
+        with self._lock:
+            live = list(self._live)
+            self._live = []
+        for rid in live:
+            try:
+                pred = self.router.remove_replica(rid)
+                pred.stop()
+                pred.join(timeout=5)
+            except Exception:
+                pass
+
+    def _reconcile_loop(self) -> None:
+        t = self._reconcile_thread
+        while not t.stopped():
+            try:
+                self.reconcile()
+            except Exception:
+                logger.exception("replica reconcile failed")
+            t._stop_evt.wait(1.0)
+
+    def reconcile(self) -> List[str]:
+        """Replace every replica the router has declared DEAD with a
+        fresh incarnation (public so tests and the bench drive it
+        deterministically). Returns the new replica ids.
+
+        Replacement is heal-to-count, not corpse-keyed 1:1: if a respawn
+        RAISES (factory/warmup failure), the corpse is already swept out
+        of ``_live`` so the next tick sees no corpse — the shortfall
+        against the pre-sweep count (floored at ``min_replicas``) is what
+        gets retried every tick until the set actually heals."""
+        states = self.router.replica_states()
+        with self._lock:
+            corpses = [rid for rid in self._live if states.get(rid) == "dead"]
+            want = max(len(self._live), self.min_replicas)
+            for rid in corpses:
+                self._live.remove(rid)
+        for rid in corpses:
+            try:
+                pred = self.router.remove_replica(rid)
+                pred.stop()
+                pred.join(timeout=5)
+            except Exception:
+                pass
+        replacements: List[str] = []
+        while True:
+            with self._lock:
+                if len(self._live) >= want:
+                    break
+            try:
+                new_rid = self._spawn()
+            except Exception:
+                # a raising spawn must not lose the slot NOR skip the
+                # other corpses' replacements — log and retry next tick
+                logger.exception(
+                    "serving replica respawn failed — retrying next tick"
+                )
+                break
+            dead = (
+                corpses[len(replacements)]
+                if len(replacements) < len(corpses) else None
+            )
+            replacements.append(new_rid)
+            self._c_replacements.inc()
+            self._flight.record(
+                "serving_replica_replace", dead=dead, replacement=new_rid
+            )
+            logger.warn(
+                "serving replica %s dead — replaced by %s", dead, new_rid
+            )
+        return replacements
+
+    @property
+    def target(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    def replica_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._live)
+
+    # -- scaling -----------------------------------------------------------
+    def scale_by(self, delta: int, reason: str = "") -> int:
+        return self.scale_to(self.target + delta, reason)
+
+    def scale_to(self, n: int, reason: str = "") -> int:
+        """Move the replica count to ``n`` (clamped to bounds); grow
+        spawns fresh incarnations, shrink retires the youngest first
+        (the oldest replicas are the best-warmed). Every actual change
+        is counted + flight-recorded."""
+        with self._lock:
+            if self._closed:
+                return len(self._live)  # teardown won: nothing to scale
+        n = max(self.min_replicas, min(self.max_replicas, int(n)))
+        old = self.target
+        if n == old:
+            return old
+        if n > old:
+            for _ in range(n - old):
+                self._spawn()
+            self._c_up.inc()
+            self._flight.record(
+                "serving_scale_up", frm=old, to=n, reason=reason[:200]
+            )
+            logger.info("serving scale up %d -> %d (%s)", old, n, reason)
+        else:
+            for _ in range(old - n):
+                with self._lock:
+                    rid = self._live.pop() if self._live else None
+                if rid is not None:
+                    self._retire(rid)
+            self._c_down.inc()
+            self._flight.record(
+                "serving_scale_down", frm=old, to=n, reason=reason[:200]
+            )
+            logger.info("serving scale down %d -> %d (%s)", old, n, reason)
+        return n
+
+    def _spawn(self) -> str:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ReplicaSet is closed")
+            idx = self._next_idx
+            self._next_idx += 1
+        rid = f"r{idx}"
+        pred = self._factory(idx)
+        pred.start()
+        if self._warm is not None:
+            self._warm(pred)
+        sig = self._signals(idx, pred) if self._signals is not None else None
+        self.router.add_replica(rid, pred, signals=sig)
+        with self._lock:
+            if self._closed:
+                born_dead = True
+            else:
+                born_dead = False
+                self._live.append(rid)
+        if born_dead:
+            # close() swept _live while we were building (factory/warmup
+            # can take seconds) and will never revisit this replica —
+            # tear it down HERE or its scheduler threads outlive the run
+            try:
+                self.router.remove_replica(rid)
+            except Exception:
+                pass
+            pred.stop()
+            pred.join(timeout=5)
+            raise RuntimeError("ReplicaSet closed during spawn")
+        self._c_spawns.inc()
+        self._flight.record("serving_replica_spawn", replica=rid)
+        return rid
+
+    def _retire(self, rid: str) -> None:
+        """Out of routing immediately; then a bounded drain grace for its
+        outstanding work before stop() (which completes in-flight
+        dispatches and sheds anything still queued with the typed
+        ``shutdown`` reject — bounded, never a hang)."""
+        try:
+            pred = self.router.remove_replica(rid)
+        except KeyError:
+            return
+        deadline = time.monotonic() + self.retire_grace_s
+        sig = None
+        try:
+            from distributed_ba3c_tpu.predict.router import replica_signals
+
+            sig = replica_signals(pred)
+        except Exception:
+            pass
+        while sig is not None and time.monotonic() < deadline:
+            try:
+                s = sig()
+                if s.get("queue_depth", 0) <= 0 and s.get("inflight", 0) <= 0:
+                    break
+            except Exception:
+                break
+            time.sleep(0.05)
+        pred.stop()
+        try:
+            pred.join(timeout=5)
+        except Exception:
+            pass
+        self._c_retires.inc()
+        self._flight.record("serving_replica_retire", replica=rid)
+
+
+class ServingScalerPolicy:
+    """The pure serving-scale decision (unit-testable without a plane).
+
+    Watermarks on the routed plane's WINDOWED signals
+    (``router.aggregate_signals``): served p99 vs the SLO and the
+    shed-rate delta. Bang-bang with the PR-7 hysteresis kit — patience
+    consecutive ticks, post-decision cooldown — because a replica move
+    costs a spawn + warmup, so the loop must be stable against one noisy
+    tick. Sign convention (opposite the fleet autoscaler's): pressure
+    ADDS replicas.
+    """
+
+    def __init__(
+        self,
+        slo_ms: float,
+        p99_high_frac: float = 0.9,
+        p99_low_frac: float = 0.4,
+        shed_high: float = 0.01,
+        patience: int = 2,
+        cooldown_ticks: int = 3,
+        step: int = 1,
+    ):
+        if slo_ms <= 0:
+            raise ValueError(f"slo_ms must be > 0, got {slo_ms}")
+        if not 0 <= p99_low_frac < p99_high_frac:
+            raise ValueError(
+                f"need 0 <= p99_low_frac < p99_high_frac, got "
+                f"{p99_low_frac}/{p99_high_frac}"
+            )
+        self.slo_ms = slo_ms
+        self.p99_high_frac = p99_high_frac
+        self.p99_low_frac = p99_low_frac
+        self.shed_high = shed_high
+        self.patience = max(1, patience)
+        self.cooldown_ticks = max(0, cooldown_ticks)
+        self.step = max(1, step)
+        self._pressured = 0
+        self._relaxed = 0
+        self._cooldown = 0
+
+    def decide(self, s: Dict[str, float]) -> Tuple[int, str]:
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return 0, ""
+        p99 = s.get("served_p99_ms")
+        shed = float(s.get("shed_rate", 0.0) or 0.0)
+        outstanding = float(s.get("outstanding_rows", 0.0) or 0.0)
+        pressured = shed > self.shed_high or (
+            p99 is not None and p99 >= self.p99_high_frac * self.slo_ms
+        )
+        # relaxed: comfortably inside the SLO with zero shedding — or a
+        # provably idle window (no samples AND nothing outstanding).
+        # p99 unknown with work outstanding is INDETERMINATE, not idle.
+        relaxed = not pressured and shed <= 0 and (
+            (p99 is not None and p99 <= self.p99_low_frac * self.slo_ms)
+            or (p99 is None and outstanding <= 0)
+        )
+        if pressured:
+            self._pressured += 1
+            self._relaxed = 0
+        elif relaxed:
+            self._relaxed += 1
+            self._pressured = 0
+        else:
+            self._pressured = self._relaxed = 0
+        if self._pressured >= self.patience:
+            self._pressured = self._relaxed = 0
+            self._cooldown = self.cooldown_ticks
+            return self.step, (
+                f"SLO pressure: served p99 "
+                f"{'n/a' if p99 is None else format(p99, '.1f')} ms vs "
+                f"{self.slo_ms} ms SLO, shed rate {shed:.2%} — add serving "
+                "capacity"
+            )
+        if self._relaxed >= self.patience:
+            self._pressured = self._relaxed = 0
+            self._cooldown = self.cooldown_ticks
+            return -self.step, (
+                f"SLO slack: served p99 "
+                f"{'n/a' if p99 is None else format(p99, '.1f')} ms well "
+                f"inside {self.slo_ms} ms with zero shed — retire a replica"
+            )
+        return 0, ""
+
+
+class ReplicaAutoscaler(StoppableThread):
+    """scrape router aggregate → decide → ``replica_set.scale_by`` (the
+    PR-7 Autoscaler loop, serving edition); every decision is counted and
+    flight-recorded with its input snapshot."""
+
+    def __init__(
+        self,
+        replica_set: ReplicaSet,
+        policy: ServingScalerPolicy,
+        interval_s: float = 2.0,
+    ):
+        super().__init__(daemon=True, name="ReplicaAutoscaler")
+        self.replica_set = replica_set
+        self.policy = policy
+        self.interval_s = interval_s
+        self._flight = telemetry.flight_recorder()
+        tele = telemetry.registry("orchestrator")
+        self._c_ticks = tele.counter("serving_autoscale_ticks_total")
+        self._c_decisions = tele.counter("serving_autoscale_decisions_total")
+
+    def run(self) -> None:
+        while not self.stopped():
+            try:
+                self.tick()
+            except Exception:
+                # one raising tick (e.g. a replica factory failing mid
+                # scale-up) must not kill the control loop for the run
+                logger.exception("serving autoscale tick failed")
+            self._stop_evt.wait(self.interval_s)
+
+    def tick(self) -> None:
+        self._c_ticks.inc()
+        s = self.replica_set.router.aggregate_signals()
+        delta, reason = self.policy.decide(s)
+        if delta == 0:
+            return
+        old = self.replica_set.target
+        new = self.replica_set.scale_by(delta, reason=reason)
+        if new == old:
+            return  # clamped at a bound — no decision to record
+        self._c_decisions.inc()
+        self._flight.record(
+            "serving_scale_decision",
+            delta=delta, frm=old, to=new, reason=reason[:200],
+            served_p99_ms=s.get("served_p99_ms"),
+            shed_rate=s.get("shed_rate"),
+            replicas_live=s.get("replicas_live"),
+        )
+
+
+def welch_z(
+    a: "collections.deque", b: "collections.deque"
+) -> Optional[float]:
+    """Welch z-statistic for mean(a) - mean(b) (the promotion test's
+    effect direction: positive = a wins). None when either window is
+    empty or both variances are zero with equal means (no evidence)."""
+    na, nb = len(a), len(b)
+    if na < 2 or nb < 2:
+        return None
+    ma = sum(a) / na
+    mb = sum(b) / nb
+    va = sum((x - ma) ** 2 for x in a) / (na - 1)
+    vb = sum((x - mb) ** 2 for x in b) / (nb - 1)
+    denom = math.sqrt(va / na + vb / nb)
+    if denom == 0:
+        if ma == mb:
+            return None
+        return math.inf if ma > mb else -math.inf
+    return (ma - mb) / denom
+
+
+class PromotionController(StoppableThread):
+    """The automated canary loop over a serving router.
+
+    ``start_canary(params)`` makes the candidate hot on every replica and
+    routes ``fraction`` of traffic to it; from then on each ``tick()``
+    (public — tests and the bench drive it deterministically):
+
+    - **rolls back** when the canary breaches the serving SLO (windowed
+      per-policy p99 from the router's exact latency tap > ``slo_ms``, or
+      its shed rate > ``breach_shed_rate``, judged only after
+      ``min_decide_tasks`` of its traffic) or statistically LOSES on
+      reward (Welch z <= -z_promote);
+    - **promotes** when the canary statistically WINS on reward (both
+      reward windows >= ``min_samples``, Welch z >= ``z_promote``) while
+      inside the SLO: ``router.promote`` republishes the canary params as
+      default everywhere and clears the split.
+
+    Reward samples arrive via ``observe_reward(policy, value)`` — the
+    caller decides what "reward" is (episode score attributed to the
+    serving policy; the bench feeds per-policy score streams). Both
+    decisions are flight-recorded WITH the full input snapshot.
+    """
+
+    IDLE, WATCHING, PROMOTED, ROLLED_BACK = (
+        "idle", "watching", "promoted", "rolled_back"
+    )
+
+    def __init__(
+        self,
+        router,
+        canary_policy: str = "canary",
+        fraction: float = 0.1,
+        slo_ms: float = 50.0,
+        min_samples: int = 30,
+        z_promote: float = 1.96,
+        breach_shed_rate: float = 0.05,
+        min_decide_tasks: int = 20,
+        window: int = 512,
+        interval_s: float = 2.0,
+    ):
+        super().__init__(daemon=True, name="PromotionController")
+        if not 0 < fraction <= 1:
+            raise ValueError(f"canary fraction {fraction} not in (0, 1]")
+        self.router = router
+        self.canary_policy = canary_policy
+        self.fraction = fraction
+        self.slo_ms = slo_ms
+        self.min_samples = max(2, min_samples)
+        self.z_promote = z_promote
+        self.breach_shed_rate = breach_shed_rate
+        self.min_decide_tasks = min_decide_tasks
+        self.interval_s = interval_s
+        self.state = self.IDLE
+        self._lock = threading.Lock()
+        self._window = window
+        self._rewards: Dict[str, collections.deque] = {}
+        self._lats: Dict[str, collections.deque] = {}
+        self._served: Dict[str, int] = {}
+        self._sheds: Dict[str, int] = {}
+        self._flight = telemetry.flight_recorder()
+        tele = telemetry.registry("orchestrator")
+        self._c_ticks = tele.counter("promotion_ticks_total")
+        self._c_promotions = tele.counter("canary_promotions_total")
+        self._c_rollbacks = tele.counter("canary_rollbacks_total")
+        self._g_state = tele.gauge("promotion_state")
+        self._g_state.set(0.0)
+        # the router's exact per-request feed: latency samples + typed
+        # sheds, attributed to the policy the ROUTER routed
+        router.latency_tap = self._tap
+
+    # -- sample feeds ------------------------------------------------------
+    def _tap(self, policy: str, latency_s, shed_reason) -> None:
+        with self._lock:
+            if latency_s is None:
+                self._sheds[policy] = self._sheds.get(policy, 0) + 1
+                return
+            self._served[policy] = self._served.get(policy, 0) + 1
+            dq = self._lats.get(policy)
+            if dq is None:
+                self._lats[policy] = dq = collections.deque(
+                    maxlen=self._window
+                )
+            dq.append(latency_s)
+
+    def observe_reward(self, policy: str, value: float) -> None:
+        with self._lock:
+            dq = self._rewards.get(policy)
+            if dq is None:
+                self._rewards[policy] = dq = collections.deque(
+                    maxlen=self._window
+                )
+            dq.append(float(value))
+
+    # -- the canary lifecycle ----------------------------------------------
+    def start_canary(self, params) -> None:
+        """Candidate goes live on ``fraction`` of traffic; evidence
+        windows reset so a previous canary's record cannot vouch for (or
+        damn) this one."""
+        self.router.add_policy(self.canary_policy, params)
+        with self._lock:
+            self._rewards.clear()
+            self._lats.clear()
+            self._served.clear()
+            self._sheds.clear()
+        self.router.set_canary(self.canary_policy, self.fraction)
+        self.state = self.WATCHING
+        self._g_state.set(1.0)
+        self._flight.record(
+            "canary_start", policy=self.canary_policy,
+            fraction=self.fraction, slo_ms=self.slo_ms,
+        )
+        logger.info(
+            "canary %s live on %.1f%% of traffic",
+            self.canary_policy, 100 * self.fraction,
+        )
+
+    def _p99_ms(self, policy: str) -> Optional[float]:
+        dq = self._lats.get(policy)
+        if not dq:
+            return None
+        xs = sorted(dq)
+        return xs[min(len(xs) - 1, int(0.99 * len(xs)))] * 1000.0
+
+    def snapshot(self) -> Dict[str, object]:
+        """The decision inputs, exactly as the next tick would read them
+        (and exactly what rides into the flight record)."""
+        with self._lock:
+            c, d = self.canary_policy, "default"
+            rc = self._rewards.get(c, ())
+            rd = self._rewards.get(d, ())
+            served_c = self._served.get(c, 0)
+            sheds_c = self._sheds.get(c, 0)
+            z = welch_z(
+                self._rewards.get(c, collections.deque()),
+                self._rewards.get(d, collections.deque()),
+            )
+            tasks_c = served_c + sheds_c
+            return {
+                "canary": c,
+                "fraction": self.fraction,
+                "slo_ms": self.slo_ms,
+                "reward_n_canary": len(rc),
+                "reward_n_default": len(rd),
+                "reward_mean_canary": (
+                    sum(rc) / len(rc) if rc else None
+                ),
+                "reward_mean_default": (
+                    sum(rd) / len(rd) if rd else None
+                ),
+                "welch_z": z,
+                "canary_tasks": tasks_c,
+                "canary_sheds": sheds_c,
+                "canary_shed_rate": (
+                    sheds_c / tasks_c if tasks_c else 0.0
+                ),
+                "canary_p99_ms": self._p99_ms(c),
+                "default_p99_ms": self._p99_ms(d),
+            }
+
+    def run(self) -> None:
+        while not self.stopped():
+            try:
+                self.tick()
+            except Exception:
+                # a raising tick must not kill the canary watch loop —
+                # an unwatched canary would serve its split forever
+                logger.exception("promotion controller tick failed")
+            self._stop_evt.wait(self.interval_s)
+
+    def tick(self) -> None:
+        if self.state != self.WATCHING:
+            return
+        self._c_ticks.inc()
+        s = self.snapshot()
+        # SLO breach first: a canary that hurts users rolls back NOW,
+        # whatever its reward says
+        if s["canary_tasks"] >= self.min_decide_tasks and (
+            s["canary_shed_rate"] > self.breach_shed_rate
+            or (
+                s["canary_p99_ms"] is not None
+                and s["canary_p99_ms"] > self.slo_ms
+            )
+        ):
+            self._rollback("slo_breach", s)
+            return
+        z = s["welch_z"]
+        enough = (
+            s["reward_n_canary"] >= self.min_samples
+            and s["reward_n_default"] >= self.min_samples
+        )
+        if enough and z is not None and z <= -self.z_promote:
+            self._rollback("reward_loss", s)
+        elif enough and z is not None and z >= self.z_promote:
+            # a reward win alone cannot promote: an external reward feed
+            # can outrun routed traffic, and below min_decide_tasks the
+            # breach check above never ran — so promotion also requires
+            # the canary's OWN serving evidence (which, having passed the
+            # breach-first check, is inside the SLO)
+            if (
+                s["canary_tasks"] >= self.min_decide_tasks
+                and s["canary_p99_ms"] is not None
+            ):
+                self._promote(s)
+
+    def _promote(self, s: Dict[str, object]) -> None:
+        self.router.promote(self.canary_policy)
+        self.state = self.PROMOTED
+        self._g_state.set(2.0)
+        self._c_promotions.inc()
+        self._flight.record("canary_promote", **s)
+        logger.info(
+            "canary %s PROMOTED to default (z=%.2f over %d/%d reward "
+            "samples)", self.canary_policy, s["welch_z"],
+            s["reward_n_canary"], s["reward_n_default"],
+        )
+
+    def _rollback(self, why: str, s: Dict[str, object]) -> None:
+        self.router.set_canary(None)
+        self.state = self.ROLLED_BACK
+        self._g_state.set(3.0)
+        self._c_rollbacks.inc()
+        self._flight.record("canary_rollback", why=why, **s)
+        logger.warn(
+            "canary %s ROLLED BACK (%s): p99=%s ms shed=%.2f%% z=%s",
+            self.canary_policy, why, s["canary_p99_ms"],
+            100 * s["canary_shed_rate"], s["welch_z"],
+        )

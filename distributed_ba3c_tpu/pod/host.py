@@ -330,7 +330,7 @@ def main(argv: Optional[list] = None) -> int:
 
     # 2. the serving plane, fed from the cache (the ONE sanctioned
     # update_params path — versioned by construction)
-    predictor = BatchedPredictor(
+    predictor = BatchedPredictor(  # ba3clint: disable=A14 — the pod host's cache-fed plane: the VersionGatedPredictor wrap is its router-equivalent front
         model,
         cache.params,
         batch_size=cfg.predict_batch_size,

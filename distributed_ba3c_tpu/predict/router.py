@@ -1,0 +1,960 @@
+"""The serving router: R predictor replicas behind one SLO-driven front.
+
+PR 9 gave ONE ``BatchedPredictor`` a latency contract (docs/serving.md);
+this module is the horizontal axis of that contract (ROADMAP item 3): a
+:class:`ServingRouter` spreads request blocks — the block wire's natural
+unit, exactly what the masters already hand their predictor — over R
+replicas, each a complete serving plane with its own scheduler thread,
+admission queue and telemetry role. The IMPALA-shaped decoupling the repo
+already has is what makes this safe: actors tolerate stale policies and
+V-trace corrects at the MEASURED lag, so a routed request may land on any
+replica at any published params version and the math stays exact.
+
+Design:
+
+- **Least-loaded dispatch**: the router tracks its own outstanding rows
+  per replica (incremented at admit, decremented at resolve) — fresher
+  than any scrape — and routes each task to the live replica with the
+  least; ties go to the least-recently-dispatched so an idle plane
+  round-robins instead of hammering replica 0.
+- **Deadline-aware overflow**: a replica that fast-rejects (bounded
+  admission queue full — the typed overload signal) does not decide the
+  request's fate; the router retries the remaining live replicas in load
+  order and only sheds to the caller when EVERY one refused. Deadline
+  sheds are different: the replica's scheduler proved the task can't be
+  served in budget anywhere (the estimate includes queue wait the task
+  already paid), so they propagate without retry.
+- **Health from the telemetry plane, not a new one**: per-replica
+  health/latency/shed signals come from the replicas' OWN telemetry
+  registries — in-process via :func:`replica_signals`, cross-process via
+  :func:`http_replica_signals` over the ``--telemetry_port`` ``/json``
+  scrape (the PR-7 ``http_signals`` pattern). A replica whose scrape goes
+  stale is DRAINED (no new traffic; in-flight tasks keep their deadline
+  semantics — drained, not blackholed) and resumes when the scrape does.
+  A replica observed dead (scheduler thread gone, or scrape dead long
+  enough) has its outstanding tasks re-shed with a typed
+  ``replica_lost`` reject so no caller ever hangs on a corpse — the
+  lockstep actor plane's masters answer those with the uniform fallback
+  exactly like any other shed.
+- **Router-owned canary split**: the router routes the canary fraction
+  (the predictor's deficit-accumulator split, lifted one level) by
+  PINNING ``policy=`` on the tasks it dispatches, so per-policy latency
+  and shed series are router-attributed — the observable feed the
+  :class:`~distributed_ba3c_tpu.orchestrate.serving.PromotionController`
+  decides from. Replicas just serve pinned policies; their group-granular
+  batching is untouched.
+- **Non-blocking param fan-out**: ``update_params`` publishes through one
+  :class:`~distributed_ba3c_tpu.utils.concurrency.LatestWinsPump` per
+  replica — latest wins per policy, a wedged replica stalls only itself,
+  and the learner's publish path never blocks (the same pump the
+  multi-fleet ``FanoutPredictors`` uses).
+
+The router duck-types ``BatchedPredictor``'s caller surface
+(``put_task``/``put_block_task``/``predict_batch``/``update_params``/
+``num_actions``/``start``/``stop``/``join``), so masters and the Trainer
+hold "a predictor" either way. Replica LIFECYCLE (spawn/retire/autoscale)
+deliberately lives one layer up, in orchestrate/serving.py's
+:class:`ReplicaSet` — the router only routes.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.request
+from typing import Callable, Dict, List, Optional, Tuple
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.predict.server import ShedReject
+from distributed_ba3c_tpu.utils import logger
+from distributed_ba3c_tpu.utils.concurrency import (
+    LatestWinsPump,
+    StoppableThread,
+)
+
+#: replica ids are embedded in Prometheus series names
+#: (``routed_<id>_rows_total``) — same grammar as policy ids
+_REPLICA_ID_RE = re.compile(r"^[a-z0-9_]{1,32}$")
+
+#: replica states. UP takes traffic; DRAINING takes none but its
+#: in-flight tasks keep their deadline semantics (stale scrape — the
+#: replica may well be healthy and unobservable); DEAD is terminal (its
+#: outstanding tasks were re-shed; a respawn is a NEW replica id).
+UP, DRAINING, DEAD = "up", "draining", "dead"
+
+
+def replica_role(base: str, idx: int) -> str:
+    """The canonical telemetry role for replica ``idx`` of a serving
+    plane: ``predictor.r<idx>`` (dotted sub-role, so ``export_scalars``
+    and the ``/json`` scrape grow per-replica series with no caller
+    enumeration — the same scheme as ``fleet_role``'s ``master.f<k>``).
+    Composes with fleets: fleet k's replica j serves as
+    ``predictor.f<k>.r<j>``."""
+    return f"{base}.r{int(idx)}"
+
+
+def _histogram_quantile_s(m: dict, q: float) -> Optional[float]:
+    """Upper-bound quantile from a log2-bucket histogram snapshot
+    (telemetry/metrics.py collect() format). Returns seconds, or None
+    when the histogram is empty. The log2 buckets make this a <=2x
+    overestimate — conservative in exactly the direction an SLO health
+    verdict wants."""
+    count = m.get("count", 0)
+    if not count:
+        return None
+    need = q * count
+    cum = 0
+    unit = m.get("unit", 1e-6)
+    for i, c in enumerate(m.get("buckets", ())):
+        cum += c
+        if cum >= need:
+            return unit * (1 << i)
+    return unit * (1 << max(0, len(m.get("buckets", ())) - 1))
+
+
+def signals_from_snapshot(series: Dict[str, dict]) -> Dict[str, float]:
+    """One replica's health dict from its registry snapshot (the ``/json``
+    document's per-role entry, or ``Registry.collect()`` in-process).
+    THE single formula — the in-process and http sources must never
+    disagree about what "healthy" reads like."""
+
+    def val(name: str) -> float:
+        return float(series.get(name, {}).get("value", 0.0))
+
+    hist = series.get("serve_latency_s", {})
+    p99 = _histogram_quantile_s(hist, 0.99)
+    out = {
+        "rows_total": val("rows_total"),
+        "sheds_total": val("sheds_total"),
+        "queue_depth": val("task_queue_depth"),
+        "inflight": val("inflight_dispatches"),
+        "serve_p99_ms": p99 * 1000.0 if p99 is not None else None,
+    }
+    if hist.get("buckets"):
+        # the raw cumulative buckets ride along so the router can compute
+        # a WINDOWED p99 (delta between health ticks) — a breach an hour
+        # ago must not read as a breach now (autoscaler/rollback inputs)
+        out["serve_hist"] = {
+            "buckets": list(hist["buckets"]),
+            "count": hist.get("count", 0),
+            "unit": hist.get("unit", 1e-6),
+        }
+    return out
+
+
+def replica_signals(predictor) -> Callable[[], Dict[str, float]]:
+    """In-process signal source over a replica's own telemetry registry
+    (+ the scheduler thread's liveness, which only an in-process observer
+    can read directly)."""
+
+    def scrape() -> Dict[str, float]:
+        s = signals_from_snapshot(
+            telemetry.registry(predictor.tele_role).collect()
+        )
+        threads = getattr(predictor, "threads", None)
+        if threads:
+            s["alive"] = float(all(t.is_alive() for t in threads))
+        return s
+
+    return scrape
+
+
+def http_replica_signals(
+    url: str, role: str = "predictor", timeout_s: float = 2.0
+) -> Callable[[], Dict[str, float]]:
+    """Signal source over a replica's ``--telemetry_port`` ``/json``
+    endpoint (the PR-7 ``http_signals`` pattern, serving edition): the
+    router and the replica need not share a process. A missing role fails
+    LOUDLY — silence would read as a healthy idle replica and blackhole
+    routed traffic onto a typo."""
+    if not url.endswith("/json"):
+        url = url.rstrip("/") + "/json"
+
+    def scrape() -> Dict[str, float]:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            doc = json.loads(r.read().decode())
+        series = doc.get(role)
+        if series is None:
+            raise KeyError(
+                f"scrape target {url} exports no {role!r} registry "
+                f"(roles: {sorted(doc)}) — wrong replica role, or the "
+                "replica's telemetry endpoint is not up"
+            )
+        return signals_from_snapshot(series)
+
+    return scrape
+
+
+class _RoutedTask:
+    """One request the router owns end-to-end: wraps the caller's
+    callbacks so the router can account latency per policy, decrement the
+    replica's outstanding load, fail queue-full rejects over to the next
+    replica, and re-shed typed if the serving replica dies. ``_lock``
+    arbitrates the one real race: a replica's scheduler resolving the
+    task concurrently with the health loop declaring that replica dead —
+    whoever flips ``_resolved`` first delivers the one outcome."""
+
+    __slots__ = (
+        "states", "k", "block", "cb", "shed_cb", "deadline", "policy",
+        "trace", "t_admit", "replica_id", "_lock", "_resolved",
+        "_admitting", "_sync_rej",
+    )
+
+    def __init__(self, states, k, block, cb, shed_cb, deadline, policy,
+                 trace, t_admit):
+        self.states = states
+        self.k = k
+        self.block = block
+        self.cb = cb
+        self.shed_cb = shed_cb
+        self.deadline = deadline
+        self.policy = policy
+        self.trace = trace
+        self.t_admit = t_admit
+        self.replica_id = None
+        self._lock = threading.Lock()
+        self._resolved = False
+        self._admitting = False
+        self._sync_rej: Optional[ShedReject] = None
+
+
+class _Replica:
+    """One replica behind the router: dispatch target + health record."""
+
+    __slots__ = (
+        "replica_id", "predictor", "signals", "state", "outstanding",
+        "outstanding_rows", "fails", "last_seen", "last_health",
+        "last_dispatch_seq", "pump", "c_rows",
+    )
+
+    def __init__(self, replica_id, predictor, signals, pump, c_rows, now):
+        self.replica_id = replica_id
+        self.predictor = predictor
+        self.signals = signals
+        self.state = UP
+        self.outstanding: Dict[int, _RoutedTask] = {}
+        self.outstanding_rows = 0
+        self.fails = 0
+        self.last_seen = now
+        self.last_health: Dict[str, float] = {}
+        self.last_dispatch_seq = 0
+        self.pump = pump
+        self.c_rows = c_rows
+
+
+class ServingRouter:
+    """Spread request blocks over R serving replicas under one SLO.
+
+    Parameters
+    ----------
+    clock: monotonic-clock callable (tests inject a fake one).
+    health_interval_s: seconds between health ticks (scrape + verdicts).
+    drain_after: consecutive failed scrapes before a replica drains.
+    dead_after: consecutive failed scrapes before a drained replica is
+        declared dead (its outstanding tasks re-shed ``replica_lost``).
+        An in-process replica whose scheduler thread died is declared
+        dead on the FIRST tick that sees it — the thread table does not
+        flake the way a scrape can.
+    tele_role: the router's own telemetry registry role.
+    """
+
+    def __init__(
+        self,
+        clock: Optional[Callable[[], float]] = None,
+        health_interval_s: float = 0.5,
+        drain_after: int = 3,
+        dead_after: int = 12,
+        tele_role: str = "router",
+    ):
+        import time as _time
+
+        self._clock = clock or _time.monotonic
+        self.health_interval_s = health_interval_s
+        self.drain_after = max(1, int(drain_after))
+        self.dead_after = max(self.drain_after, int(dead_after))
+        self.tele_role = tele_role
+        self._lock = threading.RLock()
+        self._replicas: Dict[str, _Replica] = {}
+        self._dispatch_seq = 0
+        self._canary: Optional[Tuple[str, float]] = None
+        self._canary_debt = 0.0
+        self._shadow: Optional[str] = None
+        #: latest params per policy — what promote() republishes as
+        #: default and what a grown replica is seeded from
+        self._policy_params: Dict[str, object] = {}
+        self._flight = telemetry.flight_recorder()
+        #: optional per-request tap: ``tap(policy, latency_s, shed_reason)``
+        #: with latency None on sheds — the PromotionController's exact
+        #: windowed per-policy sample feed (no histogram approximation)
+        self.latency_tap: Optional[Callable] = None
+
+        tele = telemetry.registry(tele_role)
+        self._tele = tele
+        self._c_tasks = tele.counter("routed_tasks_total")
+        self._c_rows = tele.counter("routed_rows_total")
+        self._c_overflow = tele.counter("overflow_retries_total")
+        self._c_exhausted = tele.counter("overflow_exhausted_total")
+        self._c_no_replica = tele.counter("no_replica_sheds_total")
+        self._c_lost = tele.counter("replica_lost_sheds_total")
+        self._c_drains = tele.counter("replica_drains_total")
+        self._c_resumes = tele.counter("replica_resumes_total")
+        self._c_deaths = tele.counter("replica_deaths_total")
+        self._c_publishes = tele.counter("param_publishes_total")
+        self._c_pub_coalesced = tele.counter("param_publish_coalesced_total")
+        self._c_pub_errors = tele.counter("param_publish_errors_total")
+        self._h_policy_serve: Dict[str, object] = {}
+        self._c_policy_rows: Dict[str, object] = {}
+        self._c_policy_sheds: Dict[str, object] = {}
+        import weakref
+
+        ref = weakref.ref(self)
+        tele.gauge(
+            "replicas_total",
+            fn=lambda: len(r._replicas) if (r := ref()) else 0,
+        )
+        tele.gauge(
+            "replicas_live", fn=lambda: r.live_count() if (r := ref()) else 0
+        )
+        # aggregate deltas the autoscaler watermarks on, recomputed by the
+        # health loop from per-replica scrapes (docs/observability.md)
+        self._agg: Dict[str, float] = {}
+        # per-replica histogram state for the windowed-p99 deltas; the
+        # fleet (rows, sheds) totals live in their own slot — a replica
+        # legally named "all" must not clobber them
+        self._agg_last: Dict[str, Tuple[list, int]] = {}
+        self._agg_totals: Optional[Tuple[float, float]] = None
+        self._health_thread = StoppableThread(
+            target=self._health_loop, daemon=True, name="router-health"
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._health_thread.start()
+
+    def stop(self) -> None:
+        self._health_thread.stop()
+        with self._lock:
+            pumps = [r.pump for r in self._replicas.values()]
+        for p in pumps:
+            p.stop()
+        # a router wired by cli.py owns its ReplicaSet's teardown (the
+        # startables list holds ONE handle for the whole routed plane)
+        rs = getattr(self, "replica_set", None)
+        if rs is not None:
+            rs.close()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._health_thread.is_alive():
+            self._health_thread.join(timeout)
+
+    # -- replica table -----------------------------------------------------
+    def add_replica(
+        self, replica_id: str, predictor, signals=None
+    ) -> None:
+        """Register one replica (already started by its owner —
+        orchestrate/serving.py's ReplicaSet). ``signals`` defaults to the
+        in-process source over the replica's own registry. The replica is
+        seeded with every policy the router knows, so a grown replica
+        serves the same table as its peers before the first task lands."""
+        if not _REPLICA_ID_RE.match(replica_id):
+            raise ValueError(
+                f"replica id {replica_id!r} must match "
+                f"{_REPLICA_ID_RE.pattern} (it names Prometheus series)"
+            )
+        if signals is None:
+            signals = replica_signals(predictor)
+        pump = LatestWinsPump(
+            apply=lambda policy, params, _p=predictor: _p.update_params(  # ba3clint: disable=A10 — the router IS the versioned fan-out (one publish, R replicas)
+                params, policy=policy
+            ),
+            name=f"router-pub-{replica_id}",
+            on_coalesce=self._c_pub_coalesced.inc,
+            on_error=lambda e, _r=replica_id: self._publish_error(_r, e),
+        )
+        with self._lock:
+            if replica_id in self._replicas:
+                raise ValueError(f"replica {replica_id!r} already registered")
+            for pid, params in self._policy_params.items():
+                # synchronous seed: traffic may pin this policy the moment
+                # the replica is routable
+                predictor.add_policy(pid, params)
+            if self._shadow is not None:
+                predictor.set_shadow(self._shadow)
+            c_rows = self._tele.counter(f"routed_{replica_id}_rows_total")
+            self._replicas[replica_id] = _Replica(
+                replica_id, predictor, signals, pump, c_rows, self._clock()
+            )
+        pump.start()
+        self._flight.record("replica_added", replica=replica_id)
+
+    def _publish_error(self, replica_id: str, e: Exception) -> None:
+        # a replica whose publishes fail serves a FROZEN policy table —
+        # counted, flight-recorded AND logged (the async pump must not
+        # turn a loud failure into a silent counter tick)
+        self._c_pub_errors.inc()
+        self._flight.record(
+            "router_publish_error", replica=replica_id, error=repr(e)
+        )
+        logger.error(
+            "param publish to replica %s FAILED (it serves a stale "
+            "policy until a publish succeeds): %r", replica_id, e,
+        )
+
+    def remove_replica(self, replica_id: str):
+        """Retire a replica from routing (scale-down / replacement): no
+        new traffic; its in-flight tasks keep their deadline semantics.
+        Returns the predictor so the OWNER can drain-then-stop it
+        (ReplicaSet._retire) — the router never stops what it never
+        started."""
+        with self._lock:
+            rep = self._replicas.pop(replica_id, None)
+            self._agg_last.pop(replica_id, None)
+        if rep is None:
+            raise KeyError(f"unknown replica {replica_id!r}")
+        rep.pump.stop()
+        self._flight.record(
+            "replica_retired", replica=replica_id,
+            outstanding_rows=rep.outstanding_rows,
+        )
+        return rep.predictor
+
+    def replica_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def replica_states(self) -> Dict[str, str]:
+        with self._lock:
+            return {rid: r.state for rid, r in self._replicas.items()}
+
+    def live_count(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas.values() if r.state == UP)
+
+    def outstanding_rows(self, replica_id: Optional[str] = None) -> int:
+        with self._lock:
+            if replica_id is not None:
+                rep = self._replicas.get(replica_id)
+                return rep.outstanding_rows if rep is not None else 0
+            return sum(r.outstanding_rows for r in self._replicas.values())
+
+    # -- predictor facade (policy table + sync path) -----------------------
+    @property
+    def num_actions(self) -> int:
+        with self._lock:
+            for rep in self._replicas.values():
+                return rep.predictor.num_actions
+        return 0
+
+    def add_policy(self, policy_id: str, params) -> None:
+        """Make a checkpoint hot on EVERY replica (synchronous: traffic
+        may pin the policy the moment this returns)."""
+        with self._lock:
+            reps = list(self._replicas.values())
+            self._policy_params[policy_id] = params
+        for rep in reps:
+            rep.predictor.add_policy(policy_id, params)
+
+    def set_canary(self, policy_id: Optional[str], fraction: float = 0.0) -> None:
+        """Route ``fraction`` of un-pinned traffic to ``policy_id`` —
+        the deficit split at ROUTER granularity, so per-policy latency
+        and shed series are router-attributed (the promotion
+        controller's evidence). ``None``/0 clears."""
+        with self._lock:
+            if policy_id is None or fraction <= 0:
+                self._canary = None
+                return
+            if not 0 < fraction <= 1:
+                raise ValueError(
+                    f"canary fraction {fraction} not in (0, 1]"
+                )
+            if policy_id not in self._policy_params:
+                raise KeyError(
+                    f"unknown policy {policy_id!r} — add_policy first"
+                )
+            self._canary = (policy_id, float(fraction))
+
+    def canary(self) -> Optional[Tuple[str, float]]:
+        with self._lock:
+            return self._canary
+
+    def set_shadow(self, policy_id: Optional[str]) -> None:
+        """Mirror served batches through ``policy_id`` on EVERY replica
+        (each replica shadows its own traffic locally — the mirror never
+        crosses the router). Replicas added later inherit it."""
+        with self._lock:
+            if policy_id is not None and policy_id not in self._policy_params:
+                raise KeyError(
+                    f"unknown policy {policy_id!r} — add_policy first"
+                )
+            self._shadow = policy_id
+            reps = list(self._replicas.values())
+        for rep in reps:
+            rep.predictor.set_shadow(policy_id)
+
+    def warmup(self, state_shape, dtype=None) -> None:
+        """Precompile every replica's serving buckets (fans out the
+        predictor's warmup contract; ReplicaSet-grown replicas warm at
+        spawn via its ``warm`` hook instead)."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            if dtype is None:
+                rep.predictor.warmup(state_shape)
+            else:
+                rep.predictor.warmup(state_shape, dtype)
+
+    def promote(self, policy_id: str) -> None:
+        """The canary wins: its params BECOME the default on every
+        replica (published through the pumps — a wedged replica converges
+        when it unwedges, latest wins) and the canary split clears."""
+        with self._lock:
+            params = self._policy_params.get(policy_id)
+            if params is None:
+                raise KeyError(
+                    f"unknown policy {policy_id!r} — nothing to promote"
+                )
+            self._canary = None
+            self._policy_params["default"] = params
+            reps = list(self._replicas.values())
+        for rep in reps:
+            rep.pump.publish("default", params)
+        self._c_publishes.inc()
+
+    def update_params(self, params, policy: str = "default") -> None:
+        """Publish fresh weights to every replica, WITHOUT blocking the
+        caller: one latest-wins pump per replica, so a wedged replica
+        stalls only itself and the learner's publish cadence never
+        couples to the slowest serving plane."""
+        with self._lock:
+            self._policy_params[policy] = params
+            reps = list(self._replicas.values())
+        for rep in reps:
+            rep.pump.publish(policy, params)
+        self._c_publishes.inc()
+
+    def flush_params(self, timeout: float = 5.0) -> bool:
+        """Barrier: every publish so far applied on every live replica
+        (tests/teardown; returns False if some replica stayed wedged)."""
+        with self._lock:
+            pumps = [r.pump for r in self._replicas.values()]
+        ok = True
+        for p in pumps:
+            ok = p.flush(timeout) and ok
+        return ok
+
+    def predict_batch(self, states):
+        """Synchronous batched predict (the Evaluator path): served by
+        the least-loaded live replica — every replica serves the same
+        default policy after any publish."""
+        rep = self._pick(None)
+        if rep is None:
+            raise RuntimeError("no live serving replica for predict_batch")
+        return rep.predictor.predict_batch(states)
+
+    # -- the routed dispatch path ------------------------------------------
+    def put_task(self, state, callback, *, deadline=None, policy=None,
+                 shed_callback=None, trace=None) -> bool:
+        return self._route(
+            _RoutedTask(state, 1, False, callback, shed_callback, deadline,
+                        policy, trace, self._clock())
+        )
+
+    def put_block_task(self, states, callback, *, deadline=None, policy=None,
+                       shed_callback=None, trace=None) -> bool:
+        return self._route(
+            _RoutedTask(states, int(states.shape[0]), True, callback,
+                        shed_callback, deadline, policy, trace, self._clock())
+        )
+
+    def _route_policy(self, weight: int) -> Optional[str]:
+        """The router-level deficit split (callers' thread, under lock)."""
+        c = self._canary
+        if c is None:
+            return None
+        pid, frac = c
+        self._canary_debt += frac * weight
+        if self._canary_debt >= weight:
+            self._canary_debt -= weight
+            return pid
+        return None
+
+    def _pick(self, exclude: Optional[set]) -> Optional[_Replica]:
+        with self._lock:
+            cands = [
+                r for r in self._replicas.values()
+                if r.state == UP
+                and (exclude is None or r.replica_id not in exclude)
+            ]
+            if not cands:
+                return None
+            rep = min(
+                cands,
+                key=lambda r: (r.outstanding_rows, r.last_dispatch_seq),
+            )
+            self._dispatch_seq += 1
+            rep.last_dispatch_seq = self._dispatch_seq
+            return rep
+
+    def _route(self, task: _RoutedTask) -> bool:
+        if task.policy is None:
+            with self._lock:
+                task.policy = self._route_policy(task.k)
+        tried: set = set()
+        last_rej: Optional[ShedReject] = None
+        while True:
+            rep = self._pick(tried)
+            if rep is None:
+                break
+            tried.add(rep.replica_id)
+            if self._try_admit(rep, task):
+                return True
+            with task._lock:
+                if task._resolved:
+                    # a death sweep raced the failed admit and already
+                    # delivered the typed shed — re-admitting a resolved
+                    # task would register rows no resolution ever releases
+                    return False
+            # the replica fast-rejected (bounded queue full / shutting
+            # down): the OVERFLOW path — the next-least-loaded replica
+            # gets the task before the caller hears anything
+            self._c_overflow.inc()
+            last_rej = task._sync_rej
+            task._sync_rej = None
+        # nobody could take it: deliver ONE typed reject
+        if last_rej is not None:
+            self._c_exhausted.inc()
+            rej = last_rej
+        else:
+            self._c_no_replica.inc()
+            rej = ShedReject("no_replica", task.deadline, self._clock())
+        self._resolve_shed(task, rej, None)
+        return False
+
+    def _try_admit(self, rep: _Replica, task: _RoutedTask) -> bool:
+        """One admission attempt against one replica. The replica's
+        synchronous fast-reject (put returns False, shed fired inline) is
+        captured — NOT forwarded — so the router can overflow; any
+        asynchronous shed after a successful put resolves normally."""
+        token = id(task)
+        with self._lock:
+            rep.outstanding_rows += task.k
+            rep.outstanding[token] = task
+            task.replica_id = rep.replica_id
+        with task._lock:
+            task._admitting = True
+
+        def done_cb(*args):
+            self._resolve_done(rep, task, args)
+
+        def shed_cb(rej):
+            self._on_replica_shed(rep, task, rej)
+
+        put = (
+            rep.predictor.put_block_task if task.block
+            else rep.predictor.put_task
+        )
+        try:
+            ok = put(
+                task.states, done_cb,
+                deadline=task.deadline, policy=task.policy,
+                shed_callback=shed_cb, trace=task.trace,
+            )
+        except BaseException:
+            # a RAISING put (unknown policy, oversize block) propagates
+            # to the caller — roll the registration back first, or the
+            # leaked outstanding rows repel least-loaded dispatch forever
+            # and a later _mark_dead sweep would double-deliver a shed to
+            # a caller who already saw the exception
+            with task._lock:
+                task._admitting = False
+                task._resolved = True
+            with self._lock:
+                if rep.outstanding.pop(token, None) is not None:
+                    rep.outstanding_rows -= task.k
+            raise
+        with task._lock:
+            task._admitting = False
+            sync_rej = task._sync_rej
+        if ok:
+            self._c_tasks.inc()
+            self._c_rows.inc(task.k)
+            rep.c_rows.inc(task.k)
+            self._policy_rows_counter(task.policy).inc(task.k)
+            if rep.state == DEAD:
+                # the health loop declared this replica dead BETWEEN pick
+                # and put: its orphan sweep may have run before our
+                # registration, so deliver the typed loss ourselves —
+                # _resolved makes the delivery exactly-once either way
+                if self._resolve_shed(
+                    task,
+                    ShedReject("replica_lost", task.deadline, self._clock()),
+                    rep,
+                ):
+                    self._c_lost.inc(task.k)
+                return True
+            if sync_rej is not None:
+                # an ASYNC shed raced the admit return (scheduler popped
+                # and shed before we flipped _admitting) — deliver it now,
+                # exactly once
+                self._resolve_shed(task, sync_rej, rep)
+            return True
+        with self._lock:
+            # guarded like _deregister: a concurrent _mark_dead may have
+            # already swept this registration (and zeroed the counter) —
+            # an unconditional decrement would drive it negative forever
+            if rep.outstanding.pop(token, None) is not None:
+                rep.outstanding_rows -= task.k
+        return False
+
+    def _on_replica_shed(self, rep: _Replica, task: _RoutedTask, rej) -> None:
+        with task._lock:
+            if task._admitting:
+                # synchronous fast-reject: stash for the overflow loop
+                task._sync_rej = rej
+                return
+        self._resolve_shed(task, rej, rep)
+
+    def _resolve_done(self, rep: _Replica, task: _RoutedTask, args) -> None:
+        with task._lock:
+            already = task._resolved
+            task._resolved = True
+        if already:
+            # the health loop already re-shed it (lost race) — the one
+            # outcome was delivered, but OUR registration (an overflow
+            # re-admit on a second replica) must still be released or its
+            # outstanding rows repel least-loaded dispatch forever
+            self._deregister(rep, task)
+            return
+        self._deregister(rep, task)
+        lat = self._clock() - task.t_admit
+        self._policy_serve_hist(task.policy).observe(lat)
+        tap = self.latency_tap
+        if tap is not None:
+            try:
+                tap(task.policy or "default", lat, None)
+            except Exception:
+                pass
+        if task.cb is not None:
+            task.cb(*args)
+
+    def _resolve_shed(
+        self, task: _RoutedTask, rej, rep: Optional[_Replica]
+    ) -> bool:
+        with task._lock:
+            already = task._resolved
+            task._resolved = True
+        self._deregister(rep, task)  # idempotent — see _resolve_done
+        if already:
+            return False
+        self._finish_shed(task, rej)
+        return True
+
+    def _deregister(self, rep: Optional[_Replica], task: _RoutedTask) -> None:
+        if rep is None:
+            return
+        with self._lock:
+            if rep.outstanding.pop(id(task), None) is not None:
+                rep.outstanding_rows -= task.k
+
+    def _health_loop(self) -> None:
+        t = threading.current_thread()
+        while not t.stopped():
+            try:
+                self.health_tick()
+            except Exception as e:
+                logger.warn("router health tick failed: %s", e)
+            t._stop_evt.wait(self.health_interval_s)
+
+    def health_tick(self) -> None:
+        """One health pass (public so tests and the bench drive it
+        deterministically): scrape every replica, flip states, re-shed
+        the dead, recompute the autoscaler's aggregate."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        now = self._clock()
+        for rep in reps:
+            if rep.state == DEAD:
+                continue
+            health = None
+            try:
+                health = rep.signals()
+            except Exception:
+                rep.fails += 1
+            if health is not None:
+                rep.last_health = health
+                rep.last_seen = now
+                rep.fails = 0
+                if health.get("alive", 1.0) < 1.0:
+                    self._mark_dead(rep, "scheduler thread died")
+                    continue
+                if rep.state == DRAINING:
+                    rep.state = UP
+                    self._c_resumes.inc()
+                    self._flight.record(
+                        "replica_resume", replica=rep.replica_id
+                    )
+                    logger.info(
+                        "serving replica %s scrape recovered — resumed",
+                        rep.replica_id,
+                    )
+            else:
+                if rep.fails >= self.dead_after:
+                    self._mark_dead(
+                        rep, f"scrape dead x{rep.fails}"
+                    )
+                elif rep.fails >= self.drain_after and rep.state == UP:
+                    rep.state = DRAINING
+                    self._c_drains.inc()
+                    self._flight.record(
+                        "replica_drain", replica=rep.replica_id,
+                        fails=rep.fails,
+                    )
+                    logger.warn(
+                        "serving replica %s scrape stale x%d — draining "
+                        "(in-flight deadlines still honored)",
+                        rep.replica_id, rep.fails,
+                    )
+        self._recompute_aggregate(reps)
+
+    def _mark_dead(self, rep: _Replica, why: str) -> None:
+        with self._lock:
+            if rep.state == DEAD:
+                return
+            rep.state = DEAD
+            orphans = list(rep.outstanding.values())
+            rep.outstanding.clear()
+            rep.outstanding_rows = 0
+        self._c_deaths.inc()
+        self._flight.record(
+            "replica_dead", replica=rep.replica_id, why=why,
+            orphaned_tasks=len(orphans),
+        )
+        logger.error(
+            "serving replica %s DEAD (%s) — re-shedding %d outstanding "
+            "tasks typed", rep.replica_id, why, len(orphans),
+        )
+        now = self._clock()
+        for task in orphans:
+            with task._lock:
+                if task._resolved:
+                    # the replica's scheduler resolved it in the same
+                    # instant we declared the replica dead — its outcome
+                    # was already delivered, exactly once
+                    continue
+                task._resolved = True
+            self._c_lost.inc(task.k)
+            self._finish_shed(
+                task, ShedReject("replica_lost", task.deadline, now)
+            )
+
+    def _finish_shed(self, task: _RoutedTask, rej) -> None:
+        self._policy_sheds_counter(task.policy).inc(task.k)
+        tap = self.latency_tap
+        if tap is not None:
+            try:
+                tap(task.policy or "default", None, rej.reason)
+            except Exception:
+                pass
+        if task.shed_cb is not None:
+            task.shed_cb(rej)
+
+    # -- per-policy series -------------------------------------------------
+    def _policy_serve_hist(self, policy: Optional[str]):
+        pid = policy or "default"
+        h = self._h_policy_serve.get(pid)
+        if h is None:
+            self._h_policy_serve[pid] = h = self._tele.histogram(
+                f"policy_{pid}_serve_latency_s", unit=1e-6
+            )
+        return h
+
+    def _policy_rows_counter(self, policy: Optional[str]):
+        pid = policy or "default"
+        c = self._c_policy_rows.get(pid)
+        if c is None:
+            self._c_policy_rows[pid] = c = self._tele.counter(
+                f"policy_{pid}_rows_total"
+            )
+        return c
+
+    def _policy_sheds_counter(self, policy: Optional[str]):
+        pid = policy or "default"
+        c = self._c_policy_sheds.get(pid)
+        if c is None:
+            self._c_policy_sheds[pid] = c = self._tele.counter(
+                f"policy_{pid}_sheds_total"
+            )
+        return c
+
+    def policy_health(self, policy: str) -> Dict[str, float]:
+        """Router-attributed per-policy evidence (the promotion
+        controller's scrape): routed rows, sheds, and the p99 of the
+        router-side serve latency."""
+        snap = self._tele.collect()
+        p99 = _histogram_quantile_s(
+            snap.get(f"policy_{policy}_serve_latency_s", {}), 0.99
+        )
+        return {
+            "rows": float(
+                snap.get(f"policy_{policy}_rows_total", {}).get("value", 0.0)
+            ),
+            "sheds": float(
+                snap.get(f"policy_{policy}_sheds_total", {}).get("value", 0.0)
+            ),
+            "p99_ms": p99 * 1000.0 if p99 is not None else None,
+        }
+
+    # -- the autoscaler's aggregate ----------------------------------------
+    def _recompute_aggregate(self, reps: List[_Replica]) -> None:
+        live = [r for r in reps if r.state == UP]
+        # windowed fleet p99: per-replica histogram DELTAS since the last
+        # tick, summed across live replicas — "what latency did the plane
+        # serve THIS window", not "has it ever been slow"
+        win_buckets: List[int] = []
+        win_count = 0
+        unit = 1e-6
+        for r in live:
+            hist = r.last_health.get("serve_hist")
+            if not hist:
+                continue
+            prev = self._agg_last.get(r.replica_id, ([], 0))[0]
+            cur = hist["buckets"]
+            delta = [
+                max(0, c - (prev[i] if i < len(prev) else 0))
+                for i, c in enumerate(cur)
+            ]
+            self._agg_last[r.replica_id] = (list(cur), hist["count"])
+            unit = hist.get("unit", unit)
+            if len(delta) > len(win_buckets):
+                win_buckets.extend([0] * (len(delta) - len(win_buckets)))
+            for i, c in enumerate(delta):
+                win_buckets[i] += c
+                win_count += c
+        p99 = _histogram_quantile_s(
+            {"buckets": win_buckets, "count": win_count, "unit": unit}, 0.99
+        )
+        rows = sum(r.last_health.get("rows_total", 0.0) for r in live)
+        sheds = sum(r.last_health.get("sheds_total", 0.0) for r in live)
+        last_rows, last_sheds = self._agg_totals or (rows, sheds)
+        d_rows = max(0.0, rows - last_rows)
+        d_sheds = max(0.0, sheds - last_sheds)
+        self._agg_totals = (rows, sheds)
+        total = d_rows + d_sheds
+        with self._lock:
+            self._agg = {
+                "replicas_live": float(len(live)),
+                "replicas_total": float(len(reps)),
+                "served_p99_ms": p99 * 1000.0 if p99 is not None else None,
+                "shed_rate": (d_sheds / total) if total > 0 else 0.0,
+                "outstanding_rows": float(
+                    sum(r.outstanding_rows for r in reps)
+                ),
+            }
+
+    def aggregate_signals(self) -> Dict[str, float]:
+        """The serving autoscaler's watermark inputs, recomputed each
+        health tick: worst live-replica p99, fleet-wide shed-rate delta,
+        live/total replica counts, router-known outstanding rows."""
+        with self._lock:
+            return dict(self._agg)

@@ -146,6 +146,14 @@ class ShedReject:
       overload signal; retry after backing off, or fall back;
     - ``"shutdown"``: the predictor stopped while the task waited.
 
+    The serving router (predict/router.py) adds two fleet-level reasons:
+
+    - ``"replica_lost"``: the replica this task was dispatched to died
+      before serving it (the router re-sheds a dead replica's
+      outstanding tasks so no caller hangs on a corpse);
+    - ``"no_replica"``: no live replica to dispatch to (every one is
+      draining/dead, or the router is empty).
+
     Callers decide the fallback: the actor-plane masters reply with a
     uniform-random action (the behavior log-prob stays correct for
     V-trace); a serving frontend would surface a 429/503 equivalent.

@@ -77,7 +77,8 @@ def prometheus_text(snapshots: Optional[Dict[str, Dict[str, dict]]] = None) -> s
 
 
 def export_scalars(
-    roles=("master", "predictor", "learner", "fleet", "orchestrator", "pod"),
+    roles=("master", "predictor", "router", "learner", "fleet",
+           "orchestrator", "pod"),
     prefix: str = "tele/",
 ) -> Dict[str, float]:
     """Counters + gauges flattened to ``{"tele/<role>/<name>": value}`` for

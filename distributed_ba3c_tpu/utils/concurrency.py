@@ -152,6 +152,90 @@ class StoppableThread(threading.Thread):
         return queue_get_stoppable(q, self._stop_evt, timeout)
 
 
+class LatestWinsPump(StoppableThread):
+    """Asynchronous per-key latest-wins apply worker.
+
+    ``publish(key, value)`` NEVER blocks: it overwrites the key's pending
+    slot and wakes the worker thread, which calls ``apply(key, value)`` on
+    its own time. Values a slow consumer missed are coalesced away —
+    latest wins per key — which is exactly right for monotone streams
+    like parameter publishes: serving an intermediate version nobody will
+    ever read again is pure wasted device time, and a wedged consumer
+    must stall only ITSELF, never the publisher (actors/fleet.py
+    ``FanoutPredictors`` and predict/router.py run one pump per target
+    for precisely that isolation).
+
+    ``apply`` exceptions are routed to ``on_error`` (or swallowed) — the
+    pump thread must survive one bad publish. ``flush(timeout)`` waits
+    until every pending/busy item has been applied (tests, teardown
+    barriers); it is the ONLY blocking call here.
+    """
+
+    def __init__(
+        self,
+        apply: Callable[[object, object], None],
+        name: str = "latest-pump",
+        on_coalesce: Optional[Callable[[], None]] = None,
+        on_error: Optional[Callable[[Exception], None]] = None,
+    ):
+        super().__init__(daemon=True, name=name)
+        self._apply = apply
+        self._on_coalesce = on_coalesce
+        self._on_error = on_error
+        self._cond = threading.Condition()
+        self._pending: dict = {}  # key -> latest value
+        self._busy = 0
+
+    def publish(self, key, value) -> None:
+        with self._cond:
+            if key in self._pending and self._on_coalesce is not None:
+                self._on_coalesce()
+            self._pending[key] = value
+            self._cond.notify()
+
+    def run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self.stopped():
+                    self._cond.wait(0.2)
+                if self.stopped():
+                    # teardown drops what's pending: the targets are being
+                    # torn down too, and an apply against a dying consumer
+                    # is what wedges joins
+                    self._pending.clear()
+                    self._cond.notify_all()
+                    return
+                items = list(self._pending.items())
+                self._pending.clear()
+                self._busy = len(items)
+            for key, value in items:
+                try:
+                    self._apply(key, value)
+                except Exception as e:
+                    if self._on_error is not None:
+                        try:
+                            self._on_error(e)
+                        except Exception:
+                            pass
+                finally:
+                    with self._cond:
+                        self._busy -= 1
+                        self._cond.notify_all()
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Wait until everything published so far has been applied.
+        Returns False on timeout (the consumer is wedged — which is the
+        situation the pump exists to keep OFF the publisher's thread)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._pending or self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.2))
+        return True
+
+
 class LoopThread(StoppableThread):
     """Calls ``func`` in a loop until stopped."""
 

@@ -63,3 +63,10 @@ def warn(msg, *a):
 def error(msg, *a):
     _ensure_console_handler()
     _LOGGER.error(msg, *a)
+
+
+def exception(msg, *a):
+    """Error + the current exception's traceback (call from an ``except``
+    block — the stdlib ``Logger.exception`` contract)."""
+    _ensure_console_handler()
+    _LOGGER.exception(msg, *a)

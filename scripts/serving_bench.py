@@ -55,15 +55,12 @@ def _percentiles_ms(lats):
     )
 
 
-def run_point(rate_rows_per_s: float, opts) -> dict:
-    """One open-loop rate point: fresh predictor, Poisson arrivals of
-    ``block_rows``-row block tasks for ``seconds``, drained to completion."""
-    import numpy as np
-
-    from distributed_ba3c_tpu import telemetry
+def _make_replica(opts, tele_role: str):
+    """One null-device replica (a complete BatchedPredictor serving plane
+    with simulated service time — bench.make_null_predictor) under its
+    own telemetry role, started."""
     from bench import make_null_predictor
 
-    telemetry.reset_all()
     # a stub model is enough: the null predictor never traces the forward,
     # and the scheduler only reads num_actions for the fallback contract
     model = SimpleNamespace(num_actions=opts.num_actions, apply=None)
@@ -74,8 +71,87 @@ def run_point(rate_rows_per_s: float, opts) -> dict:
         coalesce_ms=0.0,
         slo_ms=opts.slo_ms,
         queue_depth=opts.queue_depth,
+        tele_role=tele_role,
     )
     pred.start()
+    return pred
+
+
+def _make_plane(opts, replicas: int):
+    """Build the measurand: a single predictor (``replicas == 1``, the
+    PR-9 plane, byte-identical behavior) or R replicas behind the REAL
+    ServingRouter. Returns ``(target, roles, teardown)`` where ``roles``
+    are the telemetry registries the point's evidence reads."""
+    from distributed_ba3c_tpu import telemetry
+
+    telemetry.reset_all()
+    if replicas == 1:
+        pred = _make_replica(opts, "predictor")
+        return pred, ["predictor"], lambda: (pred.stop(), pred.join(5))
+
+    from distributed_ba3c_tpu.predict.router import (
+        ServingRouter,
+        replica_role,
+    )
+
+    router = ServingRouter(health_interval_s=0.1)
+    preds = []
+    roles = []
+    for i in range(replicas):
+        role = replica_role("predictor", i)
+        pred = _make_replica(opts, role)
+        router.add_replica(f"r{i}", pred)
+        preds.append(pred)
+        roles.append(role)
+    router.start()
+
+    def teardown():
+        router.stop()
+        router.join(timeout=5)
+        for p in preds:
+            p.stop()
+            p.join(timeout=5)
+
+    target = SimpleNamespace(
+        put_block_task=router.put_block_task, router=router, preds=preds
+    )
+    return target, roles, teardown
+
+
+def _replica_sub_rows(roles) -> list:
+    """Per-replica occupancy/shed/p99 evidence rows — a dead replica must
+    not hide behind a healthy aggregate (ISSUE 15 house style)."""
+    from distributed_ba3c_tpu import telemetry
+    from distributed_ba3c_tpu.predict.router import signals_from_snapshot
+
+    rows = []
+    for role in roles:
+        snap = telemetry.registry(role).collect()
+        s = signals_from_snapshot(snap)
+        batches = float(snap.get("batches_total", {}).get("value", 0.0))
+        served_rows = s["rows_total"]
+        rows.append({
+            "role": role,
+            "rows": served_rows,
+            "batches": batches,
+            "mean_batch_rows": (
+                round(served_rows / batches, 2) if batches else None
+            ),
+            "sheds": s["sheds_total"],
+            "serve_p99_ms": s["serve_p99_ms"],
+            "deadline_misses": float(
+                snap.get("deadline_misses_total", {}).get("value", 0.0)
+            ),
+        })
+    return rows
+
+
+def _drive_point(target, rate_rows_per_s: float, opts) -> tuple:
+    """The open-loop Poisson submit/drain loop against ``target`` (a
+    predictor or the routed facade). Returns (lats, sheds, submit_elapsed,
+    total_elapsed, n_tasks)."""
+    import numpy as np
+
     lats: list = []    # served: admit -> callback, seconds
     sheds: list = []   # ShedReject.reason per shed task
     state = np.zeros((opts.block_rows, 1), np.uint8)  # content is irrelevant
@@ -84,43 +160,59 @@ def run_point(rate_rows_per_s: float, opts) -> dict:
     mean_gap = opts.block_rows / rate_rows_per_s
     gaps = rng.exponential(mean_gap, n_tasks)
     clock = time.monotonic
+    t_start = clock()
+    next_t = t_start
+    for i in range(n_tasks):
+        next_t += gaps[i]
+        now = clock()
+        if next_t > now:
+            time.sleep(next_t - now)
+        t0 = clock()
+
+        def cb(a, v, lp, t0=t0):
+            lats.append(clock() - t0)
+
+        def shed_cb(rej):
+            sheds.append(rej.reason)
+
+        target.put_block_task(state, cb, shed_callback=shed_cb)
+    submit_elapsed = clock() - t_start
+    # drain: every deadline'd task resolves (served, or shed at pop)
+    deadline = clock() + opts.slo_ms / 1000.0 * 4 + 10.0
+    while len(lats) + len(sheds) < n_tasks and clock() < deadline:
+        time.sleep(0.01)
+    # served throughput is measured over the WHOLE service window
+    # (submission + drain): dividing drain-phase completions by the
+    # submission window alone would overstate capacity exactly at the
+    # knee, where the backlog drains after arrivals stop
+    total_elapsed = clock() - t_start
+    return lats, sheds, submit_elapsed, total_elapsed, n_tasks
+
+
+def run_point(rate_rows_per_s: float, opts, replicas: int = 1) -> dict:
+    """One open-loop rate point: fresh plane, Poisson arrivals of
+    ``block_rows``-row block tasks for ``seconds``, drained to
+    completion. ``replicas > 1`` drives the routed plane and embeds
+    per-replica sub-rows."""
+    from distributed_ba3c_tpu import telemetry
+
+    target, roles, teardown = _make_plane(opts, replicas)
     try:
-        t_start = clock()
-        next_t = t_start
-        for i in range(n_tasks):
-            next_t += gaps[i]
-            now = clock()
-            if next_t > now:
-                time.sleep(next_t - now)
-            t0 = clock()
-
-            def cb(a, v, lp, t0=t0):
-                lats.append(clock() - t0)
-
-            def shed_cb(rej):
-                sheds.append(rej.reason)
-
-            pred.put_block_task(state, cb, shed_callback=shed_cb)
-        submit_elapsed = clock() - t_start
-        # drain: every deadline'd task resolves (served, or shed at pop)
-        deadline = clock() + opts.slo_ms / 1000.0 * 4 + 10.0
-        while len(lats) + len(sheds) < n_tasks and clock() < deadline:
-            time.sleep(0.01)
-        # served throughput is measured over the WHOLE service window
-        # (submission + drain): dividing drain-phase completions by the
-        # submission window alone would overstate capacity exactly at the
-        # knee, where the backlog drains after arrivals stop
-        total_elapsed = clock() - t_start
+        lats, sheds, submit_elapsed, total_elapsed, n_tasks = _drive_point(
+            target, rate_rows_per_s, opts
+        )
     finally:
-        pred.stop()
-        pred.join(timeout=5)
-    scal = telemetry.registry("predictor").scalars()
-    batches = scal.get("batches_total", 0)
-    rows = scal.get("rows_total", 0)
+        teardown()
+    batches = rows = misses = 0.0
+    for role in roles:
+        scal = telemetry.registry(role).scalars()
+        batches += scal.get("batches_total", 0)
+        rows += scal.get("rows_total", 0)
+        misses += scal.get("deadline_misses_total", 0)
     p50, p90, p99 = _percentiles_ms(lats)
     served = len(lats)
     shed = len(sheds)
-    return {
+    point = {
         "offered_rows_per_s": round(
             n_tasks * opts.block_rows / max(submit_elapsed, 1e-9), 1
         ),
@@ -140,20 +232,23 @@ def run_point(rate_rows_per_s: float, opts) -> dict:
             served * opts.block_rows / max(total_elapsed, 1e-9), 1
         ),
         "mean_batch_rows": round(rows / batches, 2) if batches else None,
-        "deadline_misses": scal.get("deadline_misses_total", 0),
+        "deadline_misses": misses,
     }
+    if replicas > 1:
+        point["replica_rows"] = _replica_sub_rows(roles)
+    return point
 
 
-def run_frontier(opts) -> tuple:
+def run_frontier(opts, replicas: int = 1, rates=None) -> tuple:
     """The full sweep + gate. Returns (json_row, gate_failure_messages)."""
     from distributed_ba3c_tpu.utils.devicelock import stderr_print
 
     points = []
-    for rate in opts.rates:
-        p = run_point(rate, opts)
+    for rate in (opts.rates if rates is None else rates):
+        p = run_point(rate, opts, replicas=replicas)
         points.append(p)
         stderr_print(
-            f"serving {rate:>8.0f} rows/s offered: "
+            f"serving x{replicas} {rate:>8.0f} rows/s offered: "
             f"p99={p['p99_ms']} ms shed={p['shed_rate']:.1%} "
             f"occupancy={p['mean_batch_rows']}"
         )
@@ -201,6 +296,7 @@ def run_frontier(opts) -> tuple:
     out = {
         "metric": "serving_frontier_rows_per_s_vs_latency",
         "unit": "rows/sec vs ms",
+        "replicas": replicas,
         "slo_ms": slo,
         "block_rows": opts.block_rows,
         "batch_size": opts.batch_size,
@@ -225,6 +321,288 @@ def run_frontier(opts) -> tuple:
             ),
             "passed": not failures,
         },
+    }
+    return out, failures
+
+
+def run_chaos_rep(opts, replicas: int, rate_rows_per_s: float) -> dict:
+    """Replica-kill chaos: open-loop load on the routed plane, one
+    replica's scheduler killed mid-submission (the SIGKILL analogue for
+    an in-process replica: its queue survives, nobody serves it). The
+    acceptance shape: every task RESOLVES (served, or a typed shed the
+    masters answer with the uniform fallback — zero lockstep wedges),
+    served p99 stays inside the SLO, and the router's flight record
+    carries the replica_dead verdict."""
+    import numpy as np
+
+    from distributed_ba3c_tpu import telemetry
+
+    target, roles, teardown = _make_plane(opts, replicas)
+    router = target.router
+    victim = target.preds[0]
+    lats: list = []
+    sheds: list = []
+    state = np.zeros((opts.block_rows, 1), np.uint8)
+    rng = np.random.default_rng(opts.seed + 1)
+    n_tasks = max(2, int(opts.seconds * rate_rows_per_s / opts.block_rows))
+    kill_at = n_tasks // 2
+    gaps = rng.exponential(opts.block_rows / rate_rows_per_s, n_tasks)
+    clock = time.monotonic
+    killed_t = None
+    try:
+        t_start = clock()
+        next_t = t_start
+        for i in range(n_tasks):
+            if i == kill_at:
+                # the kill: the victim's next dispatch raises, its
+                # scheduler thread dies with the queue intact — exactly
+                # what a SIGKILL leaves behind
+                def _die(params, batch):
+                    raise RuntimeError("chaos: replica killed")
+
+                victim._dispatch = _die
+                killed_t = clock() - t_start
+            next_t += gaps[i]
+            now = clock()
+            if next_t > now:
+                time.sleep(next_t - now)
+            t0 = clock()
+
+            def cb(a, v, lp, t0=t0):
+                lats.append(clock() - t0)
+
+            def shed_cb(rej):
+                sheds.append(rej.reason)
+
+            target.put_block_task(state, cb, shed_callback=shed_cb)
+        deadline = clock() + opts.slo_ms / 1000.0 * 4 + 10.0
+        while len(lats) + len(sheds) < n_tasks and clock() < deadline:
+            time.sleep(0.01)
+    finally:
+        teardown()
+    _, _, p99 = _percentiles_ms(lats)
+    dead_events = [
+        ev for ev in telemetry.flight_recorder().snapshot()
+        if ev.get("kind") == "replica_dead"
+    ]
+    router_scal = telemetry.registry(router.tele_role).scalars()
+    return {
+        "rate_rows_per_s": rate_rows_per_s,
+        "submitted_tasks": n_tasks,
+        "killed_after_s": round(killed_t, 3) if killed_t else None,
+        "served_tasks": len(lats),
+        "shed_tasks": len(sheds),
+        "unresolved_tasks": n_tasks - len(lats) - len(sheds),
+        "sheds_by_reason": {
+            r: sheds.count(r) for r in sorted(set(sheds))
+        },
+        "served_p99_ms": p99,
+        "replica_dead_flight_events": len(dead_events),
+        "replica_lost_sheds": router_scal.get("replica_lost_sheds_total", 0),
+        "replica_rows": _replica_sub_rows(roles),
+    }
+
+
+def run_canary_rep(opts, replicas: int, rate_rows_per_s: float) -> dict:
+    """The canary loop e2e on the routed plane: a WINNING canary is
+    auto-promoted to default (statistical reward win inside the SLO),
+    then a second, OVERLOADED canary is auto-rolled-back on its SLO
+    breach — both decisions land in the flight record WITH their input
+    snapshots (the committed evidence)."""
+    import numpy as np
+
+    from distributed_ba3c_tpu import telemetry
+    from distributed_ba3c_tpu.orchestrate.serving import PromotionController
+
+    target, roles, teardown = _make_plane(opts, replicas)
+    router = target.router
+    rng = np.random.default_rng(opts.seed + 2)
+    state = np.zeros((opts.block_rows, 1), np.uint8)
+    clock = time.monotonic
+
+    def drive(n_tasks: int, rate: float):
+        gaps = rng.exponential(opts.block_rows / rate, n_tasks)
+        next_t = clock()
+        for i in range(n_tasks):
+            next_t += gaps[i]
+            now = clock()
+            if next_t > now:
+                time.sleep(next_t - now)
+            target.put_block_task(
+                state, lambda a, v, lp: None,
+                shed_callback=lambda rej: None,
+            )
+        deadline = clock() + opts.slo_ms / 1000.0 * 4 + 5.0
+        while router.outstanding_rows() > 0 and clock() < deadline:
+            time.sleep(0.01)
+
+    out = {}
+    try:
+        n = max(20, int(opts.seconds * rate_rows_per_s / opts.block_rows))
+        # phase 1: a healthy candidate that WINS on reward
+        ctrl = PromotionController(
+            router, fraction=0.3, slo_ms=opts.slo_ms,
+            min_samples=16, min_decide_tasks=8, interval_s=3600.0,
+        )
+        ctrl.start_canary({"w": np.float32(1.0)})
+        drive(n, rate_rows_per_s)
+        for i in range(20):
+            ctrl.observe_reward("canary", float(rng.normal(10.0, 0.5)))
+            ctrl.observe_reward("default", float(rng.normal(1.0, 0.5)))
+        ctrl.tick()
+        out["promoted"] = ctrl.state == PromotionController.PROMOTED
+        # phase 2: a candidate whose traffic BREACHES the SLO (offered at
+        # many times capacity, its share sheds) — auto-rollback
+        ctrl2 = PromotionController(
+            router, fraction=0.3, slo_ms=opts.slo_ms,
+            min_samples=10_000,  # reward evidence can never promote it
+            min_decide_tasks=8, breach_shed_rate=0.02, interval_s=3600.0,
+        )
+        ctrl2.start_canary({"w": np.float32(2.0)})
+        drive(4 * n, 8 * rate_rows_per_s)
+        ctrl2.tick()
+        out["rolled_back"] = ctrl2.state == PromotionController.ROLLED_BACK
+    finally:
+        teardown()
+    flights = telemetry.flight_recorder().snapshot()
+    promote_ev = [e for e in flights if e.get("kind") == "canary_promote"]
+    rollback_ev = [e for e in flights if e.get("kind") == "canary_rollback"]
+    out["promote_flight_event"] = promote_ev[-1] if promote_ev else None
+    out["rollback_flight_event"] = rollback_ev[-1] if rollback_ev else None
+    return out
+
+
+def run_replicated(opts) -> tuple:
+    """The ISSUE-15 instrument: single-replica frontier and R-replica
+    routed frontier in ONE session (same host, same nulls — same-session
+    ratios are the honest unit, PERF.md convention), the near-linear
+    scaling gate, the replica-kill chaos rep, and the canary
+    promote/rollback e2e. Returns (json_row, failures)."""
+    from distributed_ba3c_tpu.utils.devicelock import stderr_print
+
+    R = opts.replicas
+    single_row, single_failures = run_frontier(opts, replicas=1)
+    routed_rates = [r * R for r in opts.rates]
+    routed_row, routed_failures = run_frontier(
+        opts, replicas=R, rates=routed_rates
+    )
+    failures = [f"single-replica {m}" for m in single_failures]
+    failures += [f"routed x{R} {m}" for m in routed_failures]
+
+    slo = opts.slo_ms
+
+    def best(row):
+        ok = [
+            p for p in row["rate_points"]
+            if p["shed_rate"] < 0.01 and p["p99_ms"] is not None
+            and p["p99_ms"] <= slo
+        ]
+        return max(ok, key=lambda p: p["served_rows_per_s"]) if ok else None
+
+    b1, bR = best(single_row), best(routed_row)
+    required = opts.gate_frac * R
+    ratio = None
+    if b1 is None or bR is None:
+        failures.append(
+            "scaling gate FAILED: no SLO-meeting rate point on "
+            f"{'the single plane' if b1 is None else 'the routed plane'}"
+        )
+    else:
+        ratio = bR["served_rows_per_s"] / max(b1["served_rows_per_s"], 1e-9)
+        if ratio < required:
+            failures.append(
+                f"scaling gate FAILED: x{R} routed served "
+                f"{bR['served_rows_per_s']} rows/s = {ratio:.2f}x the "
+                f"single plane's {b1['served_rows_per_s']} at equal p99 "
+                f"(need >= {required:.2f}x)"
+            )
+        dead = [
+            sub for p in routed_row["rate_points"]
+            for sub in p.get("replica_rows", ())
+            if sub["rows"] == 0
+        ]
+        if dead:
+            failures.append(
+                f"scaling gate FAILED: {len(dead)} per-replica sub-rows "
+                "served ZERO rows — a dead replica is hiding in the "
+                "aggregate"
+            )
+    stderr_print(
+        f"scaling: single best {b1['served_rows_per_s'] if b1 else None} "
+        f"rows/s, x{R} routed best "
+        f"{bR['served_rows_per_s'] if bR else None} rows/s "
+        f"(ratio {f'{ratio:.2f}' if ratio else 'n/a'}, "
+        f"gate >= {required:.2f})"
+    )
+
+    chaos_rate = (
+        0.5 * bR["served_rows_per_s"] if bR is not None
+        else 0.5 * routed_rates[0]
+    )
+    chaos = run_chaos_rep(opts, R, chaos_rate)
+    if chaos["unresolved_tasks"] != 0:
+        failures.append(
+            f"chaos gate FAILED: {chaos['unresolved_tasks']} tasks never "
+            "resolved after the replica kill — a lockstep caller would "
+            "have wedged"
+        )
+    if chaos["served_p99_ms"] is not None and chaos["served_p99_ms"] > slo:
+        failures.append(
+            f"chaos gate FAILED: served p99 {chaos['served_p99_ms']} ms "
+            f"breached the {slo} ms SLO during the replica kill"
+        )
+    if chaos["replica_dead_flight_events"] == 0:
+        failures.append(
+            "chaos gate FAILED: the kill left no replica_dead flight "
+            "event — the router never noticed"
+        )
+
+    canary = run_canary_rep(
+        opts, R, chaos_rate if bR is None else 0.3 * bR["served_rows_per_s"]
+    )
+    if not canary["promoted"] or canary["promote_flight_event"] is None:
+        failures.append(
+            "canary gate FAILED: the winning candidate was not promoted "
+            "(or its decision left no flight event)"
+        )
+    if not canary["rolled_back"] or canary["rollback_flight_event"] is None:
+        failures.append(
+            "canary gate FAILED: the SLO-breaching candidate was not "
+            "rolled back (or its decision left no flight event)"
+        )
+
+    out = {
+        "metric": "replicated_serving_rows_per_s_vs_latency",
+        "unit": "rows/sec vs ms",
+        "replicas": R,
+        "slo_ms": slo,
+        "block_rows": opts.block_rows,
+        "batch_size": opts.batch_size,
+        "service_us": opts.service_us,
+        "queue_depth": opts.queue_depth,
+        "seconds": opts.seconds,
+        "seed": opts.seed,
+        "device_free_proxy": True,
+        "single": single_row,
+        "routed": routed_row,
+        "scaling_gate": {
+            "criterion": (
+                f"x{R} routed served rows/s >= {required:.2f}x the "
+                f"same-session single plane at equal p99 inside the "
+                f"{slo} ms SLO; every per-replica sub-row served > 0"
+            ),
+            "single_best_rows_per_s": (
+                b1["served_rows_per_s"] if b1 else None
+            ),
+            "routed_best_rows_per_s": (
+                bR["served_rows_per_s"] if bR else None
+            ),
+            "ratio": round(ratio, 3) if ratio is not None else None,
+            "required": round(required, 3),
+        },
+        "chaos": chaos,
+        "canary": canary,
+        "gate": {"passed": not failures},
     }
     return out, failures
 
@@ -260,14 +638,30 @@ def parse_opts(argv=None) -> SimpleNamespace:
     ap.add_argument("--seconds", type=float, default=4.0, help="per rate point")
     ap.add_argument("--num_actions", type=int, default=6)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--replicas", type=int, default=1,
+        help="R > 1 = the ISSUE-15 replicated instrument: single AND "
+        "R-replica routed frontiers same-session (routed rates = --rates "
+        "x R), the near-linear scaling gate, a replica-kill chaos rep, "
+        "and the canary promote/rollback e2e",
+    )
+    ap.add_argument(
+        "--gate_frac", type=float, default=0.8,
+        help="scaling gate: routed served rows/s must be >= gate_frac * R "
+        "x the same-session single plane (0.8 * 4 = the 3.2x acceptance "
+        "bar)",
+    )
     args = ap.parse_args(argv)
     rates = [float(r) for r in args.rates.split(",") if r.strip()]
     if not rates:
         raise SystemExit("--rates must name at least one rate")
+    if args.replicas < 1:
+        raise SystemExit(f"--replicas must be >= 1, got {args.replicas}")
     return SimpleNamespace(rates=rates, **{
         k: getattr(args, k)
         for k in ("block_rows", "batch_size", "service_us", "slo_ms",
-                  "queue_depth", "seconds", "num_actions", "seed")
+                  "queue_depth", "seconds", "num_actions", "seed",
+                  "replicas", "gate_frac")
     })
 
 
@@ -277,7 +671,10 @@ def main(argv=None) -> int:
     # device-free mode)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     opts = parse_opts(argv)
-    out, failures = run_frontier(opts)
+    if opts.replicas > 1:
+        out, failures = run_replicated(opts)
+    else:
+        out, failures = run_frontier(opts)
     # the JSON (per-point evidence) prints BEFORE any gate verdict — the
     # evidence is most valuable exactly when the gate fails
     print(json.dumps(out))

@@ -1,0 +1,21 @@
+"""A14 clean fixture: the sanctioned serving shapes outside predict/."""
+
+
+def master_dispatch(self, states):
+    # dispatch on an INJECTED handle (router or predictor — the caller
+    # decided): the masters' shape, clean by construction
+    return self.predictor.put_block_task(states, lambda a, v, lp: None)
+
+
+def sanctioned_factory(model, params, cfg):
+    # the cli factory shape: construction carries the sanction
+    from distributed_ba3c_tpu.predict.server import BatchedPredictor
+
+    pred = BatchedPredictor(model, params, batch_size=cfg.predict_batch_size)  # ba3clint: disable=A14 — fleet-assembly factory, lifecycle owned by cli startables
+    return pred
+
+
+def routed_dispatch(router, states):
+    # the router is predict/'s own front door — dispatching at it is the
+    # whole point
+    return router.put_block_task(states, lambda a, v, lp: None)

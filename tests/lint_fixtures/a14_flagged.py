@@ -1,0 +1,17 @@
+"""A14 flagged fixture: ad-hoc serving planes outside predict/."""
+from distributed_ba3c_tpu.predict.server import BatchedPredictor
+
+
+def stand_up_private_plane(model, params, states):
+    # direct construction outside predict/: an unrouted serving plane
+    pred = BatchedPredictor(model, params, batch_size=8)
+    pred.warmup((4, 4, 2))
+    # dispatch at the locally-constructed predictor: traffic that
+    # bypasses the router's overflow/health/canary machinery
+    pred.put_block_task(states, lambda a, v, lp: None)
+    return pred
+
+
+def another_ctor_shape(server, model, params):
+    # dotted construction resolves the same way
+    return server.BatchedPredictor(model, params)

@@ -135,10 +135,16 @@ def test_build_fleet_planes_roles_and_fanout():
     assert planes[1].pipe_c2s == "ipc:///tmp/q-c2s-f1"
 
     fan = FanoutPredictors([pl.predictor for pl in planes])
-    fan.update_params({"w": 1})
-    assert all(len(p.published) == 1 for p in made)
-    assert fan.predict_batch(None) == "fleet0-answer"
-    assert fan.num_actions == N_ACTIONS
+    try:
+        fan.update_params({"w": 1})
+        # the fan-out is asynchronous (per-predictor latest-wins pumps);
+        # flush() is the settledness barrier
+        assert fan.flush(10.0)
+        assert all(len(p.published) == 1 for p in made)
+        assert fan.predict_batch(None) == "fleet0-answer"
+        assert fan.num_actions == N_ACTIONS
+    finally:
+        fan.close()
 
     # single-fleet assembly keeps the legacy role names
     single = build_fleet_planes(
@@ -147,6 +153,112 @@ def test_build_fleet_planes_roles_and_fanout():
     )
     assert single[0].predictor.role == "predictor"
     assert single[0].master[4] == "master"
+
+
+def test_fanout_publish_nonblocking_under_wedged_predictor():
+    """ISSUE 15 satellite: ``FanoutPredictors.update_params`` must never
+    block the learner's publish path — a deliberately WEDGED replica
+    stalls only its own pump, the healthy replica keeps receiving, and
+    when the wedge releases the stalled replica converges to the LATEST
+    params (intermediate versions coalesced away, counted)."""
+    import threading
+
+    telemetry.reset_all()
+    release = threading.Event()
+
+    class _WedgedPred:
+        num_actions = N_ACTIONS
+
+        def __init__(self):
+            self.published = []
+
+        def update_params(self, params, policy="default"):
+            assert release.wait(30), "test wedge never released"
+            self.published.append(params)
+
+    class _HealthyPred:
+        num_actions = N_ACTIONS
+
+        def __init__(self):
+            self.published = []
+
+        def update_params(self, params, policy="default"):
+            self.published.append(params)
+
+    wedged, healthy = _WedgedPred(), _HealthyPred()
+    fan = FanoutPredictors([wedged, healthy])
+    try:
+        n = 50
+        t0 = time.monotonic()
+        for v in range(n):
+            fan.update_params({"v": v})
+        publish_elapsed = time.monotonic() - t0
+        # the learner's thread never waited on the wedge (the old
+        # sequential fan-out blocked here for the wedge's full duration)
+        assert publish_elapsed < 2.0, (
+            f"publish path blocked {publish_elapsed:.1f}s behind a wedged "
+            "replica"
+        )
+        # the healthy replica converges to the latest publish regardless
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if healthy.published and healthy.published[-1] == {"v": n - 1}:
+                break
+            time.sleep(0.01)
+        assert healthy.published[-1] == {"v": n - 1}
+        # un-wedge: the stalled replica gets the LATEST params, with the
+        # skipped intermediates coalesced (not replayed one by one)
+        release.set()
+        assert fan.flush(10.0)
+        assert wedged.published[-1] == {"v": n - 1}
+        assert len(wedged.published) < n
+        scal = telemetry.registry("learner").scalars()
+        assert scal["fanout_publishes_total"] == n
+        assert scal["fanout_publishes_coalesced_total"] > 0
+    finally:
+        release.set()
+        fan.close()
+
+
+def test_fanout_publish_error_is_loud():
+    """A replica whose update_params RAISES must not fail silently inside
+    the pump thread: the error is counted AND flight-recorded (the old
+    synchronous fan-out propagated the exception to the learner; the
+    async pump keeps the evidence loud)."""
+    telemetry.reset_all()
+
+    class _BrokenPred:
+        num_actions = N_ACTIONS
+
+        def update_params(self, params, policy="default"):
+            raise RuntimeError("device OOM during policy device_put")
+
+    class _HealthyPred:
+        num_actions = N_ACTIONS
+
+        def __init__(self):
+            self.published = []
+
+        def update_params(self, params, policy="default"):
+            self.published.append(params)
+
+    healthy = _HealthyPred()
+    fan = FanoutPredictors([_BrokenPred(), healthy])
+    try:
+        fan.update_params({"v": 1})
+        assert fan.flush(10.0)
+        # the healthy fleet still got the publish
+        assert healthy.published == [{"v": 1}]
+        scal = telemetry.registry("learner").scalars()
+        assert scal["fanout_publish_errors_total"] == 1
+        evs = [
+            e for e in telemetry.flight_recorder().snapshot()
+            if e.get("kind") == "fanout_publish_error"
+        ]
+        assert len(evs) == 1
+        assert evs[0]["fleet"] == 0 and "OOM" in evs[0]["error"]
+    finally:
+        fan.close()
 
 
 # ---------------------------------------------------------------------------

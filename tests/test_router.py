@@ -1,0 +1,927 @@
+"""The replicated serving plane (predict/router.py + orchestrate/serving.py,
+docs/serving.md, ISSUE 15).
+
+Router mechanics are tested against deterministic fake replicas (manual
+serve pumps, injectable health signals, a fake clock shared with the
+router) so every state transition is driven explicitly; one integration
+test runs REAL BatchedPredictor replicas and kills one scheduler thread
+mid-load — the in-process analogue of a SIGKILLed replica process — to
+prove the typed-shed rebalance end to end.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.orchestrate.serving import (
+    PromotionController,
+    ReplicaAutoscaler,
+    ReplicaSet,
+    ServingScalerPolicy,
+    welch_z,
+)
+from distributed_ba3c_tpu.predict.router import (
+    DEAD,
+    DRAINING,
+    UP,
+    ServingRouter,
+    http_replica_signals,
+    replica_role,
+    replica_signals,
+    signals_from_snapshot,
+)
+from distributed_ba3c_tpu.predict.server import BatchedPredictor, ShedReject
+
+N_ACTIONS = 4
+STATE = (4, 4, 2)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self._lock = threading.Lock()
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        with self._lock:
+            self.t += dt
+
+
+class FakeReplica:
+    """Deterministic replica: tasks queue until the test pumps
+    ``serve()``; a bounded cap fast-rejects like the real admission
+    queue; health is whatever the test injects."""
+
+    num_actions = N_ACTIONS
+
+    def __init__(self, cap=64):
+        self.cap = cap
+        self.tasks = []  # (states, k, policy, cb, shed_cb)
+        self.policies = {"default": None}
+        self.published = []
+        self.alive = True
+        self.scrape_fails = False
+        self.stopped = False
+        self.rows = 0
+        self.sheds = 0
+
+    # -- the predictor caller surface -----------------------------------
+    def put_block_task(self, states, cb, deadline=None, policy=None,
+                       shed_callback=None, trace=None):
+        return self._put(states, states.shape[0], policy, cb, shed_callback)
+
+    def put_task(self, state, cb, deadline=None, policy=None,
+                 shed_callback=None, trace=None):
+        return self._put(state, 1, policy, cb, shed_callback)
+
+    def _put(self, states, k, policy, cb, shed_cb):
+        if policy is not None and policy not in self.policies:
+            raise KeyError(f"unknown policy {policy!r}")
+        if len(self.tasks) >= self.cap or self.stopped:
+            if shed_cb is not None:
+                shed_cb(ShedReject(
+                    "shutdown" if self.stopped else "queue_full"
+                ))
+            self.sheds += k
+            return False
+        self.tasks.append((states, k, policy, cb, shed_cb))
+        return True
+
+    def add_policy(self, pid, params):
+        self.policies[pid] = params
+
+    def update_params(self, params, policy="default"):
+        self.published.append((policy, params))
+        self.policies[policy] = params
+
+    def predict_batch(self, states):
+        return "sync-answer"
+
+    def start(self):
+        pass
+
+    def stop(self):
+        self.stopped = True
+
+    def join(self, timeout=None):
+        pass
+
+    # -- test controls ---------------------------------------------------
+    def serve(self, n=None):
+        """Resolve the oldest ``n`` queued tasks (all when None)."""
+        n = len(self.tasks) if n is None else n
+        for _ in range(min(n, len(self.tasks))):
+            states, k, policy, cb, _ = self.tasks.pop(0)
+            self.rows += k
+            acts = np.zeros(k, np.int32)
+            if k == 1:
+                cb(0, 0.0, -1.0)
+            else:
+                cb(acts, np.zeros(k, np.float32), np.full(k, -1.0))
+
+    def signals(self):
+        if self.scrape_fails:
+            raise ConnectionError("scrape target gone")
+        return {
+            "alive": 1.0 if self.alive else 0.0,
+            "rows_total": float(self.rows),
+            "sheds_total": float(self.sheds),
+            "queue_depth": float(len(self.tasks)),
+            "inflight": 0.0,
+            "serve_p99_ms": 1.0,
+        }
+
+
+def _router(n_replicas=2, cap=64, **kw):
+    telemetry.reset_all()
+    clock = _FakeClock()
+    kw.setdefault("health_interval_s", 3600.0)  # ticks driven manually
+    router = ServingRouter(clock=clock, **kw)
+    reps = [FakeReplica(cap=cap) for _ in range(n_replicas)]
+    for i, rep in enumerate(reps):
+        router.add_replica(f"r{i}", rep, signals=rep.signals)
+    return router, reps, clock
+
+
+def _block(k=4):
+    return np.zeros((k, *STATE), np.uint8)
+
+
+def _router_scalar(name):
+    return telemetry.registry("router").scalars().get(name, 0.0)
+
+
+def _flight_events(kind):
+    return [
+        ev for ev in telemetry.flight_recorder().snapshot()
+        if ev.get("kind") == kind
+    ]
+
+
+# -- dispatch ---------------------------------------------------------------
+
+
+def test_least_loaded_dispatch_balances_rows():
+    router, (r0, r1), _ = _router()
+    for _ in range(4):
+        router.put_block_task(_block(4), lambda a, v, lp: None)
+    # nothing served yet: outstanding rows steer each block to the
+    # emptier replica — 2 blocks each, never 3/1
+    assert len(r0.tasks) == 2 and len(r1.tasks) == 2
+    assert router.outstanding_rows() == 16
+    r0.serve()
+    r1.serve()
+    assert router.outstanding_rows() == 0
+    assert _router_scalar("routed_rows_total") == 16
+    assert _router_scalar("routed_r0_rows_total") == 8
+    assert _router_scalar("routed_r1_rows_total") == 8
+
+
+def test_slow_replica_gets_less_traffic():
+    router, (r0, r1), _ = _router()
+    served = []
+    for i in range(8):
+        router.put_block_task(_block(2), lambda a, v, lp: served.append(1))
+        # r1 serves immediately; r0 never does — its backlog repels load
+        r1.serve()
+    assert len(r0.tasks) == 1  # only the very first block landed on r0
+    assert _router_scalar("routed_r1_rows_total") == 14
+
+
+def test_overflow_fails_over_before_shedding():
+    router, (r0, r1), _ = _router(cap=1)
+    sheds = []
+    # two blocks fill both replicas (cap 1 each)
+    assert router.put_block_task(_block(2), lambda *a: None,
+                                 shed_callback=sheds.append)
+    assert router.put_block_task(_block(2), lambda *a: None,
+                                 shed_callback=sheds.append)
+    assert not sheds
+    # the third finds BOTH full: one typed reject after trying every
+    # replica, exactly once
+    ok = router.put_block_task(_block(2), lambda *a: None,
+                               shed_callback=sheds.append)
+    assert ok is False
+    assert len(sheds) == 1
+    assert sheds[0].reason == "queue_full"
+    assert _router_scalar("overflow_retries_total") >= 2
+    assert _router_scalar("overflow_exhausted_total") == 1
+    # overflow earlier: fill ONLY the least-loaded candidate and prove
+    # the task lands on the other instead of shedding
+    r0.serve()
+    r1.serve()
+    r0.cap = 0  # r0 now always fast-rejects
+    ok = router.put_block_task(_block(2), lambda *a: None,
+                               shed_callback=sheds.append)
+    assert ok is True
+    assert len(sheds) == 1  # no new shed — the overflow path absorbed it
+    assert len(r1.tasks) == 1
+
+
+def test_no_replica_is_a_typed_shed():
+    telemetry.reset_all()
+    router = ServingRouter(clock=_FakeClock(), health_interval_s=3600.0)
+    sheds = []
+    ok = router.put_block_task(_block(2), lambda *a: None,
+                               shed_callback=sheds.append)
+    assert ok is False and sheds[0].reason == "no_replica"
+    assert _router_scalar("no_replica_sheds_total") == 1
+
+
+# -- health: drain / resume / dead ------------------------------------------
+
+
+def test_stale_scrape_drains_then_resumes():
+    router, (r0, r1), _ = _router()
+    done = []
+    # r0 takes one block, then its scrape goes stale
+    router.put_block_task(_block(2), lambda a, v, lp: done.append(1))
+    assert len(r0.tasks) == 1
+    r0.scrape_fails = True
+    for _ in range(router.drain_after):
+        router.health_tick()
+    assert router.replica_states()["r0"] == DRAINING
+    assert _flight_events("replica_drain")
+    # drained, NOT blackholed: new traffic avoids r0 ...
+    for _ in range(3):
+        router.put_block_task(_block(2), lambda a, v, lp: None)
+    assert len(r0.tasks) == 1 and len(r1.tasks) == 3
+    # ... while its in-flight task still resolves normally (through the
+    # router's wrapper, so r0's outstanding accounting drains too)
+    r0.serve()
+    assert done == [1]
+    assert router.outstanding_rows("r0") == 0
+    # scrape recovers -> the replica resumes taking traffic
+    r0.scrape_fails = False
+    router.health_tick()
+    assert router.replica_states()["r0"] == UP
+    assert _flight_events("replica_resume")
+    r1.serve()
+    router.put_block_task(_block(2), lambda a, v, lp: None)
+    assert len(r0.tasks) == 1
+
+
+def test_dead_replica_resheds_outstanding_typed_and_rebalances():
+    router, (r0, r1), _ = _router()
+    sheds, served = [], []
+    for _ in range(2):
+        router.put_block_task(
+            _block(4), lambda a, v, lp: served.append(1),
+            shed_callback=sheds.append,
+        )
+    assert len(r0.tasks) == 1 and len(r1.tasks) == 1
+    # r0's scheduler dies (the SIGKILL analogue): first health tick sees
+    # alive=0 and re-sheds its outstanding task with the typed reject
+    r0.alive = False
+    router.health_tick()
+    assert router.replica_states()["r0"] == DEAD
+    assert len(sheds) == 1 and sheds[0].reason == "replica_lost"
+    assert _router_scalar("replica_lost_sheds_total") == 4
+    ev = _flight_events("replica_dead")
+    assert ev and ev[0]["replica"] == "r0"
+    # traffic rebalances to the survivor; nothing hangs
+    for _ in range(3):
+        router.put_block_task(
+            _block(4), lambda a, v, lp: served.append(1),
+            shed_callback=sheds.append,
+        )
+    assert len(r0.tasks) == 1  # the corpse's queue never grows
+    r1.serve()
+    assert len(served) == 4  # r1's original + the 3 rebalanced
+    assert len(sheds) == 1
+
+
+def test_canary_split_is_router_attributed():
+    router, (r0, r1), _ = _router()
+    router.add_policy("canary", {"w": "c"})
+    # add_policy seeds EVERY replica synchronously
+    assert r0.policies["canary"] == {"w": "c"}
+    assert r1.policies["canary"] == {"w": "c"}
+    router.set_canary("canary", 0.25)
+    for _ in range(16):
+        router.put_task(np.zeros(STATE, np.uint8), lambda a, v, lp: None)
+    r0.serve()
+    r1.serve()
+    scal = telemetry.registry("router").scalars()
+    assert scal["policy_canary_rows_total"] == 4
+    assert scal["policy_default_rows_total"] == 12
+    # the canary tasks were PINNED (the replicas saw the policy id), so
+    # per-policy latency is router-attributed
+    assert scal["policy_canary_serve_latency_s_count"] == 4
+    router.set_canary(None)
+    assert router.canary() is None
+    with pytest.raises(KeyError):
+        router.set_canary("ghost", 0.5)
+
+
+def test_update_params_fans_out_async_and_promote_republishes():
+    router, (r0, r1), _ = _router()
+    router.add_policy("canary", {"v": "canary-params"})
+    router.update_params({"v": 1})
+    assert router.flush_params(10.0)
+    assert ("default", {"v": 1}) in r0.published
+    assert ("default", {"v": 1}) in r1.published
+    router.promote("canary")
+    assert router.flush_params(10.0)
+    assert r0.published[-1] == ("default", {"v": "canary-params"})
+    assert r1.published[-1] == ("default", {"v": "canary-params"})
+    assert router.canary() is None
+    router.stop()
+    router.join(timeout=5)
+
+
+# -- signal sources ----------------------------------------------------------
+
+
+def test_signals_from_snapshot_and_http_source():
+    telemetry.reset_all()
+    reg = telemetry.registry("predictor")
+    reg.counter("rows_total").inc(100)
+    reg.counter("sheds_total").inc(7)
+    h = reg.histogram("serve_latency_s", unit=1e-6)
+    for _ in range(100):
+        h.observe(0.004)
+    s = signals_from_snapshot(reg.collect())
+    assert s["rows_total"] == 100 and s["sheds_total"] == 7
+    # log2 buckets: the p99 upper bound is within 2x of the true 4 ms
+    assert 4.0 <= s["serve_p99_ms"] <= 8.2
+    assert s["serve_hist"]["count"] == 100
+
+    server = telemetry.TelemetryServer(port=0, host="127.0.0.1")
+    server.start()
+    try:
+        src = http_replica_signals(
+            f"http://127.0.0.1:{server.port}", role="predictor"
+        )
+        s2 = src()
+        assert s2["rows_total"] == 100
+        assert s2["serve_p99_ms"] == s["serve_p99_ms"]
+        missing = http_replica_signals(
+            f"http://127.0.0.1:{server.port}", role="predictor.r99"
+        )
+        with pytest.raises(KeyError, match="predictor.r99"):
+            missing()
+    finally:
+        server.stop()
+        server.join(timeout=5)
+        server.close()
+
+
+def test_replica_role_formula():
+    assert replica_role("predictor", 3) == "predictor.r3"
+    assert replica_role(telemetry.fleet_role("predictor", 1), 2) == \
+        "predictor.f1.r2"
+
+
+# -- the serving scaler ------------------------------------------------------
+
+
+def test_serving_scaler_policy_decisions():
+    pol = ServingScalerPolicy(
+        slo_ms=50.0, patience=2, cooldown_ticks=2, step=1
+    )
+    breach = {"served_p99_ms": 49.0, "shed_rate": 0.0, "outstanding_rows": 10}
+    ok = {"served_p99_ms": 5.0, "shed_rate": 0.0, "outstanding_rows": 10}
+    mid = {"served_p99_ms": 30.0, "shed_rate": 0.0, "outstanding_rows": 10}
+    unknown_busy = {"served_p99_ms": None, "shed_rate": 0.0,
+                    "outstanding_rows": 10}
+    idle = {"served_p99_ms": None, "shed_rate": 0.0, "outstanding_rows": 0}
+    # pressure needs `patience` consecutive ticks
+    assert pol.decide(breach) == (0, "")
+    d, reason = pol.decide(breach)
+    assert d == 1 and "SLO pressure" in reason
+    # cooldown absorbs the next 2 ticks
+    assert pol.decide(breach) == (0, "")
+    assert pol.decide(breach) == (0, "")
+    # shed-rate alone is a breach signal too
+    shed = {"served_p99_ms": 5.0, "shed_rate": 0.5, "outstanding_rows": 0}
+    pol.decide(shed)
+    d, _ = pol.decide(shed)
+    assert d == 1
+    pol.decide(ok)
+    pol.decide(ok)
+    # relaxed after cooldown+patience -> scale down
+    pol2 = ServingScalerPolicy(slo_ms=50.0, patience=2, cooldown_ticks=0)
+    pol2.decide(ok)
+    d, reason = pol2.decide(ok)
+    assert d == -1 and "slack" in reason
+    # the deadband holds still, and UNKNOWN p99 with work outstanding is
+    # indeterminate (never reads as slack)
+    pol3 = ServingScalerPolicy(slo_ms=50.0, patience=1, cooldown_ticks=0)
+    assert pol3.decide(mid) == (0, "")
+    assert pol3.decide(unknown_busy) == (0, "")
+    # a provably idle window IS slack
+    d, _ = pol3.decide(idle)
+    assert d == -1
+    with pytest.raises(ValueError):
+        ServingScalerPolicy(slo_ms=0)
+
+
+def test_replica_set_scales_and_autoscaler_records_decisions():
+    telemetry.reset_all()
+    clock = _FakeClock()
+    router = ServingRouter(clock=clock, health_interval_s=3600.0)
+    made = []
+
+    def factory(idx):
+        rep = FakeReplica()
+        made.append(rep)
+        return rep
+
+    rs = ReplicaSet(
+        router, factory, min_replicas=1, max_replicas=3,
+        signals=lambda idx, pred: pred.signals, retire_grace_s=0.1,
+    )
+    rs.start(1)
+    assert rs.target == 1 and router.live_count() == 1
+    # SLO breach in the aggregate drives the autoscaler up
+    auto = ReplicaAutoscaler(
+        rs, ServingScalerPolicy(slo_ms=50.0, patience=1, cooldown_ticks=0),
+        interval_s=3600.0,
+    )
+    router._agg = {"served_p99_ms": 49.0, "shed_rate": 0.0,
+                   "replicas_live": 1.0, "outstanding_rows": 5.0}
+    auto.tick()
+    assert rs.target == 2 and router.live_count() == 2
+    ev = _flight_events("serving_scale_decision")
+    assert ev and ev[-1]["delta"] == 1 and ev[-1]["served_p99_ms"] == 49.0
+    # incarnation ids are monotonic — the new replica is r1
+    assert router.replica_ids() == ["r0", "r1"]
+    # slack scales back down; the retired replica is stopped
+    router._agg = {"served_p99_ms": 2.0, "shed_rate": 0.0,
+                   "replicas_live": 2.0, "outstanding_rows": 0.0}
+    auto.tick()
+    assert rs.target == 1
+    assert made[1].stopped
+    # clamped at min_replicas: no decision recorded for a no-op
+    n_dec = len(_flight_events("serving_scale_decision"))
+    router._agg = {"served_p99_ms": 2.0, "shed_rate": 0.0,
+                   "replicas_live": 1.0, "outstanding_rows": 0.0}
+    auto.tick()
+    assert rs.target == 1
+    assert len(_flight_events("serving_scale_decision")) == n_dec
+    rs.close()
+
+
+def test_replica_set_reconcile_replaces_dead_replica():
+    """A replica the router declares DEAD is swept out of the set and
+    REPLACED by a fresh incarnation — a fixed-count deployment heals to
+    its target without an autoscaler in the loop."""
+    telemetry.reset_all()
+    clock = _FakeClock()
+    router = ServingRouter(clock=clock, health_interval_s=3600.0)
+    made = []
+
+    def factory(idx):
+        rep = FakeReplica()
+        made.append(rep)
+        return rep
+
+    rs = ReplicaSet(
+        router, factory, min_replicas=2, max_replicas=4,
+        signals=lambda idx, pred: pred.signals, retire_grace_s=0.1,
+    )
+    rs.start(2)
+    assert router.replica_ids() == ["r0", "r1"]
+    made[0].alive = False
+    router.health_tick()
+    assert router.replica_states()["r0"] == DEAD
+    replaced = rs.reconcile()
+    # the corpse is gone, a NEW incarnation (never a reused id) serves
+    assert replaced == ["r2"]
+    assert router.replica_ids() == ["r1", "r2"]
+    assert rs.target == 2
+    assert made[0].stopped
+    ev = _flight_events("serving_replica_replace")
+    assert ev and ev[-1]["dead"] == "r0" and ev[-1]["replacement"] == "r2"
+    assert telemetry.registry("orchestrator").scalars()[
+        "serving_replica_replacements_total"] == 1
+    # traffic flows to the replacement
+    served = []
+    router.put_block_task(_block(2), lambda a, v, lp: served.append(1))
+    router.put_block_task(_block(2), lambda a, v, lp: served.append(1))
+    made[1].serve()
+    made[2].serve()
+    assert len(served) == 2
+    rs.close()
+
+
+def test_reconcile_retries_after_failed_respawn():
+    """A RAISING respawn (factory/warmup failure) must not lose the slot
+    forever: the corpse is already swept, so the next tick has no corpse
+    to key off — reconcile heals to the pre-sweep count instead."""
+    telemetry.reset_all()
+    clock = _FakeClock()
+    router = ServingRouter(clock=clock, health_interval_s=3600.0)
+    made, fail = [], [False]
+
+    def factory(idx):
+        if fail[0]:
+            raise RuntimeError("transient factory failure")
+        rep = FakeReplica()
+        made.append(rep)
+        return rep
+
+    rs = ReplicaSet(
+        router, factory, min_replicas=2, max_replicas=4,
+        signals=lambda idx, pred: pred.signals, retire_grace_s=0.1,
+    )
+    rs.start(2)
+    made[0].alive = False
+    router.health_tick()
+    fail[0] = True
+    assert rs.reconcile() == []  # respawn raised — no replacement yet
+    assert rs.target == 1 and router.live_count() == 1
+    # next tick: no corpse left, but the shortfall is retried and heals
+    fail[0] = False
+    replaced = rs.reconcile()
+    assert replaced == ["r3"]
+    assert rs.target == 2 and router.live_count() == 2
+    rs.close()
+
+
+def test_overflow_does_not_readmit_a_swept_task():
+    """A death sweep racing a fast-reject resolves the task mid-overflow:
+    the router must deliver that ONE typed outcome and stop — re-admitting
+    the resolved task on a healthy replica would register rows that no
+    resolution ever releases (the resolvers also deregister on the
+    already-resolved branch for the same reason)."""
+    telemetry.reset_all()
+    clock = _FakeClock()
+    router = ServingRouter(clock=clock, health_interval_s=3600.0)
+    r0 = FakeReplica(cap=0)  # always fast-rejects
+    r1 = FakeReplica()
+    router.add_replica("r0", r0, signals=r0.signals)
+    router.add_replica("r1", r1, signals=r1.signals)
+    orig = r0._put
+
+    def racing_put(states, k, policy, cb, shed_cb):
+        ok = orig(states, k, policy, cb, shed_cb)  # sync fast-reject
+        # the health loop declares r0 dead in the same instant — its
+        # sweep finds the still-registered task and resolves it
+        router._mark_dead(router._replicas["r0"], "raced sweep")
+        return ok
+
+    r0._put = racing_put
+    sheds = []
+    ok = router.put_task(
+        np.zeros(STATE, np.uint8), lambda *a: None,
+        shed_callback=sheds.append,
+    )
+    assert ok is False
+    # exactly ONE typed outcome, delivered by the sweep
+    assert len(sheds) == 1 and sheds[0].reason == "replica_lost"
+    # the healthy replica never saw the already-resolved task
+    assert r1.tasks == []
+    assert router._replicas["r1"].outstanding_rows == 0
+    assert not router._replicas["r1"].outstanding
+    router.stop()
+
+
+def test_replica_set_refuses_spawn_after_close():
+    """A scale-up tick racing teardown must not register a replica that
+    nothing will ever stop: after close(), scale_to is a no-op and
+    _spawn refuses (a replica built mid-close is torn down, not leaked)."""
+    telemetry.reset_all()
+    clock = _FakeClock()
+    router = ServingRouter(clock=clock, health_interval_s=3600.0)
+    made = []
+
+    def factory(idx):
+        rep = FakeReplica()
+        made.append(rep)
+        return rep
+
+    rs = ReplicaSet(
+        router, factory, min_replicas=1, max_replicas=4,
+        signals=lambda idx, pred: pred.signals,
+    )
+    rs.start(1)
+    rs.close()
+    assert made[0].stopped
+    assert rs.scale_to(3) == 0  # no-op: teardown won
+    with pytest.raises(RuntimeError):
+        rs._spawn()
+    assert len(made) == 1 and router.live_count() == 0
+    router.stop()
+
+
+def test_control_loops_survive_raising_tick():
+    """One raising tick must not kill the ReplicaAutoscaler or
+    PromotionController thread for the rest of the run."""
+    telemetry.reset_all()
+    clock = _FakeClock()
+    router = ServingRouter(clock=clock, health_interval_s=3600.0)
+    rep = FakeReplica()
+    router.add_replica("r0", rep, signals=rep.signals)
+    rs = ReplicaSet(
+        router, lambda idx: FakeReplica(), min_replicas=1, max_replicas=2,
+        signals=lambda idx, pred: pred.signals,
+    )
+    for ctor in (
+        lambda: ReplicaAutoscaler(
+            rs, ServingScalerPolicy(slo_ms=50.0), interval_s=0.01
+        ),
+        lambda: PromotionController(router, interval_s=0.01),
+    ):
+        loop = ctor()
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise RuntimeError("tick blew up")
+
+        loop.tick = boom
+        loop.start()
+        deadline = time.monotonic() + 5
+        while len(calls) < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(calls) >= 3, "loop died after the first raising tick"
+        assert loop.is_alive()
+        loop.stop()
+        loop.join(2)
+    router.stop()
+
+
+def test_raising_put_rolls_back_registration():
+    """A put that RAISES (unknown policy, oversize block) propagates to
+    the caller — but the router-side registration must roll back, or the
+    phantom outstanding rows repel least-loaded dispatch forever and a
+    later death sweep double-delivers a shed."""
+    router, (r0,), clock = _router(n_replicas=1)
+    with pytest.raises(KeyError):
+        router.put_task(
+            np.zeros(STATE, np.uint8), lambda *a: None, policy="nope"
+        )
+    assert router._replicas["r0"].outstanding_rows == 0
+    assert not router._replicas["r0"].outstanding
+    # the replica still serves normal traffic
+    served = []
+    assert router.put_task(
+        np.zeros(STATE, np.uint8), lambda *a: served.append(1)
+    )
+    r0.serve()
+    assert served == [1]
+    # a death sweep re-sheds only the live registrations — never the
+    # raised task (its caller already saw the exception)
+    sheds = []
+    router.put_task(
+        np.zeros(STATE, np.uint8), lambda *a: None,
+        shed_callback=sheds.append,
+    )
+    r0.alive = False
+    router.health_tick()
+    clock.advance(1e9)
+    router.health_tick()
+    assert len(sheds) == 1 and sheds[0].reason == "replica_lost"
+    router.stop()
+
+
+# -- the promotion controller ------------------------------------------------
+
+
+def _promotion_rig(**kw):
+    router, reps, clock = _router()
+    kw.setdefault("min_samples", 5)
+    kw.setdefault("min_decide_tasks", 4)
+    kw.setdefault("fraction", 0.5)
+    kw.setdefault("slo_ms", 50.0)
+    ctrl = PromotionController(router, **kw)
+    return router, reps, clock, ctrl
+
+
+def test_promotion_on_statistical_win_with_flight_snapshot():
+    router, (r0, r1), clock, ctrl = _promotion_rig()
+    ctrl.start_canary({"v": "candidate"})
+    assert router.canary() == ("canary", 0.5)
+    # serve canary traffic inside the SLO (fake clock never advances ->
+    # latency 0)
+    for _ in range(8):
+        router.put_task(np.zeros(STATE, np.uint8), lambda a, v, lp: None)
+    r0.serve()
+    r1.serve()
+    # the canary's reward stream clearly beats the default's
+    for i in range(8):
+        ctrl.observe_reward("canary", 10.0 + 0.1 * i)
+        ctrl.observe_reward("default", 1.0 + 0.1 * i)
+    ctrl.tick()
+    assert ctrl.state == PromotionController.PROMOTED
+    assert router.canary() is None
+    assert router.flush_params(10.0)
+    # every replica now serves the candidate as DEFAULT
+    assert r0.published[-1] == ("default", {"v": "candidate"})
+    assert r1.published[-1] == ("default", {"v": "candidate"})
+    ev = _flight_events("canary_promote")
+    assert len(ev) == 1
+    # the decision carries its input snapshot
+    assert ev[0]["reward_n_canary"] == 8 and ev[0]["welch_z"] > 1.96
+    assert ev[0]["canary_p99_ms"] is not None
+    assert telemetry.registry("orchestrator").scalars()[
+        "canary_promotions_total"] == 1
+
+
+def test_rollback_on_slo_breach_with_flight_snapshot():
+    router, (r0, r1), clock, ctrl = _promotion_rig()
+    ctrl.start_canary({"v": "bad"})
+    # canary traffic breaches the SLO: 200 ms between admit and serve
+    for _ in range(8):
+        router.put_task(np.zeros(STATE, np.uint8), lambda a, v, lp: None)
+    clock.advance(0.2)
+    r0.serve()
+    r1.serve()
+    ctrl.tick()
+    assert ctrl.state == PromotionController.ROLLED_BACK
+    assert router.canary() is None  # the split cleared, default serves on
+    ev = _flight_events("canary_rollback")
+    assert len(ev) == 1 and ev[0]["why"] == "slo_breach"
+    assert ev[0]["canary_p99_ms"] > 50.0
+    assert telemetry.registry("orchestrator").scalars()[
+        "canary_rollbacks_total"] == 1
+    # default keeps serving after the rollback
+    served = []
+    router.put_task(np.zeros(STATE, np.uint8), lambda a, v, lp: served.append(1))
+    r0.serve()
+    r1.serve()
+    assert served == [1]
+
+
+def test_rollback_on_reward_loss():
+    router, (r0, r1), clock, ctrl = _promotion_rig()
+    ctrl.start_canary({"v": "worse"})
+    for _ in range(8):
+        router.put_task(np.zeros(STATE, np.uint8), lambda a, v, lp: None)
+    r0.serve()
+    r1.serve()
+    for i in range(8):
+        ctrl.observe_reward("canary", 1.0 + 0.1 * i)
+        ctrl.observe_reward("default", 10.0 + 0.1 * i)
+    ctrl.tick()
+    assert ctrl.state == PromotionController.ROLLED_BACK
+    assert _flight_events("canary_rollback")[-1]["why"] == "reward_loss"
+
+
+def test_insufficient_evidence_keeps_watching():
+    router, (r0, r1), clock, ctrl = _promotion_rig(min_samples=50)
+    ctrl.start_canary({"v": "x"})
+    for i in range(4):
+        ctrl.observe_reward("canary", 10.0 + i)
+        ctrl.observe_reward("default", 1.0 + i)
+    ctrl.tick()
+    assert ctrl.state == PromotionController.WATCHING
+    assert router.canary() is not None
+
+
+def test_reward_win_without_serving_evidence_does_not_promote():
+    """An external reward feed can outrun routed canary traffic; below
+    min_decide_tasks the SLO-breach check never runs, so a reward win
+    with no serving evidence must KEEP WATCHING — not republish an
+    un-latency-tested candidate as default everywhere."""
+    router, (r0, r1), clock, ctrl = _promotion_rig()
+    n0 = len(_flight_events("canary_promote"))
+    ctrl.start_canary({"v": "candidate"})
+    # decisive reward win arrives before the canary served ANY traffic
+    for i in range(8):
+        ctrl.observe_reward("canary", 10.0 + 0.1 * i)
+        ctrl.observe_reward("default", 1.0 + 0.1 * i)
+    ctrl.tick()
+    assert ctrl.state == PromotionController.WATCHING
+    assert router.canary() is not None
+    assert len(_flight_events("canary_promote")) == n0
+    # once the canary carries real traffic inside the SLO, the same
+    # reward evidence promotes
+    for _ in range(8):
+        router.put_task(np.zeros(STATE, np.uint8), lambda a, v, lp: None)
+    r0.serve()
+    r1.serve()
+    ctrl.tick()
+    assert ctrl.state == PromotionController.PROMOTED
+    ev = _flight_events("canary_promote")
+    assert len(ev) == n0 + 1 and ev[-1]["canary_tasks"] >= 4
+
+
+def test_welch_z():
+    import collections
+
+    a = collections.deque([10.0, 10.1, 10.2, 9.9])
+    b = collections.deque([1.0, 1.1, 0.9, 1.05])
+    assert welch_z(a, b) > 10
+    assert welch_z(b, a) < -10
+    assert welch_z(collections.deque([1.0]), b) is None
+    same = collections.deque([2.0, 2.0, 2.0])
+    assert welch_z(same, collections.deque([2.0, 2.0])) is None
+    assert welch_z(
+        collections.deque([3.0, 3.0]), collections.deque([2.0, 2.0])
+    ) == float("inf")
+
+
+# -- integration: real replicas, one killed mid-load -------------------------
+
+
+class _NullServingPred(BatchedPredictor):
+    """Real scheduler machinery over a host-side null device (the
+    test_serving pattern); ``die=True`` makes the next dispatch raise —
+    killing the scheduler thread exactly like a SIGKILL leaves a replica:
+    queue intact, nobody serving it."""
+
+    service_s = 0.0
+    die = False
+
+    def _dispatch(self, params, batch):
+        if self.die:
+            raise RuntimeError("injected replica death")
+        b = np.asarray(batch)
+        k = b.shape[0]
+        acts = (np.arange(k) % N_ACTIONS).astype(np.int32)
+        return k, (
+            acts, np.zeros(k, np.float32), np.full(k, -1.0, np.float32), acts
+        )
+
+    def _collect(self, handle):
+        if self.service_s:
+            time.sleep(self.service_s)
+        return handle[1]
+
+
+@pytest.mark.slow
+def test_killed_real_replica_traffic_rebalances_without_wedging():
+    """ISSUE 15 acceptance: a replica whose scheduler dies mid-load is
+    detected via its thread liveness, its outstanding tasks come back as
+    TYPED replica_lost sheds (the masters' uniform-fallback path — no
+    lockstep server ever wedges waiting on a corpse), and the surviving
+    replica absorbs the traffic."""
+    telemetry.reset_all()
+    model = SimpleNamespace(num_actions=N_ACTIONS, apply=None)
+    preds = [
+        _NullServingPred(
+            model, {}, batch_size=8, coalesce_ms=0.0, queue_depth=64,
+            slo_ms=1000.0, tele_role=replica_role("predictor", i),
+        )
+        for i in range(2)
+    ]
+    router = ServingRouter(health_interval_s=0.05)
+    for i, p in enumerate(preds):
+        p.start()
+        router.add_replica(f"r{i}", p)
+    router.start()
+    served, sheds = [], []
+    lock = threading.Lock()
+
+    def cb(a, v, lp):
+        with lock:
+            served.append(1)
+
+    def shed_cb(rej):
+        with lock:
+            sheds.append(rej.reason)
+
+    try:
+        # healthy baseline over both replicas
+        for _ in range(6):
+            router.put_block_task(_block(4), cb, shed_callback=shed_cb)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            with lock:
+                if len(served) == 6:
+                    break
+            time.sleep(0.01)
+        assert len(served) == 6
+        # kill r0's scheduler mid-load: stuff its queue while it dies
+        preds[0].die = True
+        for _ in range(20):
+            router.put_block_task(_block(4), cb, shed_callback=shed_cb)
+        # every task RESOLVES — served by r1, or typed replica_lost from
+        # the dead r0 — nobody hangs
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with lock:
+                if len(served) + len(sheds) == 26:
+                    break
+            time.sleep(0.01)
+        with lock:
+            assert len(served) + len(sheds) == 26, (
+                f"{len(served)} served + {len(sheds)} sheds — a caller "
+                "is hung on the dead replica"
+            )
+            assert all(r == "replica_lost" for r in sheds)
+        assert router.replica_states()["r0"] == DEAD
+        # the plane keeps serving on the survivor
+        n0 = len(served)
+        router.put_block_task(_block(4), cb, shed_callback=shed_cb)
+        deadline = time.monotonic() + 10
+        while len(served) == n0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(served) == n0 + 1
+    finally:
+        router.stop()
+        router.join(timeout=5)
+        for p in preds:
+            p.stop()
+            p.join(timeout=5)

@@ -1151,6 +1151,96 @@ class IngestExtraCopyRule(Rule):
                 )
 
 
+#: the serving plane's front-door types; constructing one directly outside
+#: predict/ (or dispatching at one so constructed) bypasses the router's
+#: health/overflow/canary machinery and the sanctioned predictor factories
+_PREDICTOR_CTOR_BARE = {"BatchedPredictor"}
+_PREDICTOR_CTOR_SUFFIXES = (".BatchedPredictor",)
+_PREDICTOR_DISPATCH_ATTRS = {"put_task", "put_block_task"}
+
+
+class UnroutedPredictorDispatchRule(Rule):
+    """A14: ``BatchedPredictor`` constructed — or dispatched at, when
+    locally constructed — outside ``predict/`` and the sanctioned
+    factories.
+
+    The serving tier is ROUTED (predict/router.py, docs/serving.md): R
+    replicas behind health-checked least-loaded dispatch with
+    deadline-aware overflow, replica autoscaling and the canary
+    promotion loop. A ``BatchedPredictor`` constructed ad hoc outside
+    ``predict/`` is a serving plane nothing routes, nothing health-checks
+    and nothing autoscales — its traffic bypasses the overflow path (so
+    its overload sheds instead of failing over) and its policy table
+    drifts from the router's (a promotion never reaches it). Construction
+    belongs to the sanctioned factories — cli.py's ``make_predictor``
+    (handed to the fleet assembly), the pod host's versioned-cache-fed
+    predictor, orchestrate/serving.py's ``ReplicaSet`` factory — each of
+    which carries the suppression naming why its lifecycle is owned
+    (bench/test null planes are the raw measurand and suppress the same
+    way). Dispatch (``put_task``/``put_block_task``) is flagged only on
+    receivers ASSIGNED from a flagged construction in the same file:
+    masters dispatching whatever predictor-or-router they were handed
+    stay clean by construction — injection IS the sanctioned shape.
+    """
+
+    id = "A14"
+    name = "unrouted-predictor-dispatch"
+    summary = "BatchedPredictor constructed/dispatched outside predict/ bypasses the routed serving plane"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if "predict" in ctx.path.replace(os.sep, "/").split("/"):
+            return
+        local_names: Set[str] = set()
+        ctor_nodes = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = ctx.info.resolve(node.func)
+            if resolved and (
+                resolved in _PREDICTOR_CTOR_BARE
+                or resolved.endswith(_PREDICTOR_CTOR_SUFFIXES)
+            ):
+                ctor_nodes.append(node)
+                p = parent(node)
+                if isinstance(p, ast.Assign):
+                    for t in p.targets:
+                        local_names |= _target_names(t)
+                elif isinstance(p, ast.AnnAssign):
+                    local_names |= _target_names(p.target)
+        for node in ctor_nodes:
+            yield ctx.finding(
+                self, node,
+                "direct BatchedPredictor construction outside predict/ — "
+                "an unrouted serving plane (no health checks, no "
+                "overflow, no canary reach); route through the sanctioned "
+                "factories (cli.py make_predictor / ReplicaSet) or "
+                "suppress naming who owns this plane's lifecycle "
+                "(docs/serving.md)",
+            )
+        if not local_names:
+            return
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _PREDICTOR_DISPATCH_ATTRS
+            ):
+                names = {
+                    n.id
+                    for n in ast.walk(node.func.value)
+                    if isinstance(n, ast.Name)
+                }
+                if names & local_names:
+                    yield ctx.finding(
+                        self, node,
+                        f".{node.func.attr}() at a locally-constructed "
+                        "BatchedPredictor — serving traffic belongs on "
+                        "the router (or an injected predictor handle); "
+                        "this dispatch bypasses overflow/health/canary "
+                        "routing (docs/serving.md)",
+                    )
+
+
 ACTOR_RULES = [
     BareThreadRule(),
     BlockingQueueOpRule(),
@@ -1165,4 +1255,5 @@ ACTOR_RULES = [
     OrphanSpanRule(),
     UnboundedSocketWaitRule(),
     IngestExtraCopyRule(),
+    UnroutedPredictorDispatchRule(),
 ]
