@@ -96,7 +96,7 @@ class FanoutPredictors:
         # the pumps only multiply its publish across fleets
         self._pumps = [
             LatestWinsPump(
-                apply=lambda policy, params, _p=pred: _p.update_params(  # ba3clint: disable=A10
+                apply=lambda policy, params, _p=pred: _p.update_params(
                     params, policy=policy
                 ),
                 name=f"param-fanout-{k}",

@@ -169,7 +169,7 @@ class BA3CSimulatorMaster(SimulatorMaster):
                 # the serve RTT span (recv -> actions in hand); the
                 # predictor's dispatch/fetch sub-spans ride the same trace
                 st.trace = ref.hop("predict", self.tele_role)
-            blk.steps.append(st)  # ba3clint: disable=A3 — protocol-serialized, see above
+            blk.steps.append(st)
             self.send_block_actions(ident, actions)
 
         # same fallback contract as the per-env path: a shed block gets

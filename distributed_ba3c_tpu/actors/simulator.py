@@ -168,7 +168,7 @@ class BlockStatesView:
         if age >= hist - 1:
             return self.window[:, j].transpose(1, 2, 0)  # zero-copy view
         # young env: the zeroed history planes need a (small) private copy
-        arr = np.ascontiguousarray(self.window[:, j].transpose(1, 2, 0))  # ba3clint: disable=A13
+        arr = np.ascontiguousarray(self.window[:, j].transpose(1, 2, 0))
         arr[..., : hist - 1 - age] = 0
         return arr
 

@@ -225,7 +225,7 @@ class VTraceSimulatorMaster(SimulatorMaster):
                 # serve RTT (recv -> actions); the predictor's own
                 # dispatch/fetch sub-spans ride the same trace
                 st.trace = ref.hop("predict", self.tele_role)
-            blk.steps.append(st)  # ba3clint: disable=A3 — protocol-serialized, see above
+            blk.steps.append(st)
             self.send_block_actions(ident, actions)
 
         if ref is None:

@@ -650,7 +650,7 @@ class BlockStager:
                     )
                 # bounded wait, then re-scan (fence = in-flight H2D)
                 self._c_waits.inc()
-                self._free_wait()
+                self._free_wait()  # ba3cflow: disable=F1 — _free_wait drops self._lock around its sleep (see its body)
 
     def _free_wait(self) -> None:
         # called with the lock held: drop it for the sleep so to_device/

@@ -489,7 +489,7 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
                     msg = [idents[i], stacks[i], float(rewards[i]), bool(dones[i])]
                     if i == 0 and tele is not None:
                         msg.append(tele)
-                    push.send(  # ba3clint: disable=A6,A12 — compat foil (lockstep park), see docstring
+                    push.send(  # ba3clint: disable=A12 — compat foil (lockstep park), see docstring
                         dumps(msg)
                     )
                 for i in range(B):

@@ -624,30 +624,30 @@ def make_overlap_step(
         if block is None:
             aparams = prep_jit(train.params)
             astate, block = actor_jit(aparams, astate)
-            jax.block_until_ready(block)  # ba3clint: disable=J1,J6
+            jax.block_until_ready(block)  # ba3clint: disable=J6
         t_actor, t_learner, t_pair = [], [], []
         for _ in range(max(1, reps)):
             # solo actor (fully synced — measurement, not training)
             aparams = prep_jit(train.params)
-            jax.block_until_ready(aparams)  # ba3clint: disable=J1,J6
+            jax.block_until_ready(aparams)  # ba3clint: disable=J1
             t0 = time.perf_counter()
             astate, next_block = actor_jit(aparams, astate)
             # measurement fence: the probe times the actor ALONE
-            jax.block_until_ready(next_block)  # ba3clint: disable=J1,J6
+            jax.block_until_ready(next_block)  # ba3clint: disable=J1
             t_actor.append(time.perf_counter() - t0)
             # solo learner
             t0 = time.perf_counter()
             train, m = learner_jit(train, block, beta_arr, lr_arr)
-            jax.block_until_ready(train)  # ba3clint: disable=J1,J6
+            jax.block_until_ready(train)  # ba3clint: disable=J1
             t_learner.append(time.perf_counter() - t0)
             block = next_block
             # overlapped pair: both enqueued, one sync at the end
             aparams = prep_jit(train.params)
-            jax.block_until_ready(aparams)  # ba3clint: disable=J1,J6
+            jax.block_until_ready(aparams)  # ba3clint: disable=J1
             t0 = time.perf_counter()
             astate, next_block = actor_jit(aparams, astate)
             train, m = learner_jit(train, block, beta_arr, lr_arr)
-            jax.block_until_ready((next_block, train))  # ba3clint: disable=J1,J6
+            jax.block_until_ready((next_block, train))  # ba3clint: disable=J1
             t_pair.append(time.perf_counter() - t0)
             block = next_block
         med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731

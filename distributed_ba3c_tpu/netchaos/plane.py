@@ -123,7 +123,7 @@ class NetChaosPlane:
             schedule = FaultSchedule.from_json(schedule)
         elif isinstance(schedule, dict):
             schedule = FaultSchedule(
-                schedule.get("links", {}), seed=schedule.get("seed", 0)
+                schedule.get("links", {}), seed=schedule.get("seed", 0)  # ba3cflow: disable=F6 — isinstance(schedule, dict) branch: the param is a plain dict here, not a FaultSchedule
             )
         self.schedule: FaultSchedule = schedule
         self.push_pull_front_hwm = int(push_pull_front_hwm)

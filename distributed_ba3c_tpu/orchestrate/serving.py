@@ -40,7 +40,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from distributed_ba3c_tpu import telemetry
-from distributed_ba3c_tpu.utils import logger
+from distributed_ba3c_tpu.utils import logger, sanitizer
 from distributed_ba3c_tpu.utils.concurrency import StoppableThread
 
 
@@ -79,10 +79,16 @@ class ReplicaSet:
         self._warm = warm
         self._signals = signals
         self.retire_grace_s = retire_grace_s
-        self._lock = threading.Lock()
+        # RLock so the sanitizer's guarded roster can verify the CALLING
+        # thread holds it (a plain Lock only knows someone does)
+        self._lock = threading.RLock()
         self._next_idx = 0
         self._closed = False
-        self._live: List[str] = []  # replica ids, spawn order
+        #: replica ids, spawn order; every shape change is lock-serialized
+        #: (BA3C_SANITIZE=1 enforces this at runtime)
+        self._live: List[str] = sanitizer.wrap_guarded_list(
+            self._lock, "replica_set.live"
+        )
         self._flight = telemetry.flight_recorder()
         tele = telemetry.registry("orchestrator")
         self._c_spawns = tele.counter("serving_replica_spawns_total")
@@ -123,7 +129,9 @@ class ReplicaSet:
             self._reconcile_thread.join(timeout=5)
         with self._lock:
             live = list(self._live)
-            self._live = []
+            # clear in place, not rebind: rebinding would swap the
+            # sanitizer-wrapped roster for a plain list
+            del self._live[:]
         for rid in live:
             try:
                 pred = self.router.remove_replica(rid)

@@ -62,12 +62,13 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 import urllib.request
 from typing import Callable, Dict, List, Optional, Tuple
 
 from distributed_ba3c_tpu import telemetry
 from distributed_ba3c_tpu.predict.server import ShedReject
-from distributed_ba3c_tpu.utils import logger
+from distributed_ba3c_tpu.utils import logger, sanitizer
 from distributed_ba3c_tpu.utils.concurrency import (
     LatestWinsPump,
     StoppableThread,
@@ -275,7 +276,11 @@ class ServingRouter:
         self.dead_after = max(self.drain_after, int(dead_after))
         self.tele_role = tele_role
         self._lock = threading.RLock()
-        self._replicas: Dict[str, _Replica] = {}
+        # replica table: read lock-free on the dispatch fast path, shape
+        # changed only under _lock — BA3C_SANITIZE=1 enforces the latter
+        self._replicas: Dict[str, _Replica] = sanitizer.wrap_guarded_dict(
+            self._lock, "router.replicas"
+        )
         self._dispatch_seq = 0
         self._canary: Optional[Tuple[str, float]] = None
         self._canary_debt = 0.0
@@ -335,9 +340,15 @@ class ServingRouter:
     def stop(self) -> None:
         self._health_thread.stop()
         with self._lock:
-            pumps = [r.pump for r in self._replicas.values()]
-        for p in pumps:
-            p.stop()
+            reps = list(self._replicas.values())
+        for r in reps:
+            r.pump.stop()
+        # the router started these threads, so it joins them: a bounded
+        # shared deadline, not per-pump, so a fleet of wedged applies
+        # cannot stretch shutdown to R * timeout (ba3cflow F5)
+        deadline = time.monotonic() + 5.0
+        for r in reps:
+            r.pump.join(timeout=max(0.0, deadline - time.monotonic()))
         # a router wired by cli.py owns its ReplicaSet's teardown (the
         # startables list holds ONE handle for the whole routed plane)
         rs = getattr(self, "replica_set", None)
@@ -365,27 +376,43 @@ class ServingRouter:
         if signals is None:
             signals = replica_signals(predictor)
         pump = LatestWinsPump(
-            apply=lambda policy, params, _p=predictor: _p.update_params(  # ba3clint: disable=A10 — the router IS the versioned fan-out (one publish, R replicas)
+            apply=lambda policy, params, _p=predictor: _p.update_params(
                 params, policy=policy
             ),
             name=f"router-pub-{replica_id}",
             on_coalesce=self._c_pub_coalesced.inc,
             on_error=lambda e, _r=replica_id: self._publish_error(_r, e),
         )
+        # snapshot the policy table under the lock but seed OUTSIDE it:
+        # add_policy reaches jax.device_put (seconds under first-touch
+        # compile), and self._lock gates every dispatch and the health
+        # loop — a slow device must not wedge the whole routing plane
         with self._lock:
             if replica_id in self._replicas:
                 raise ValueError(f"replica {replica_id!r} already registered")
-            for pid, params in self._policy_params.items():
-                # synchronous seed: traffic may pin this policy the moment
-                # the replica is routable
-                predictor.add_policy(pid, params)
-            if self._shadow is not None:
-                predictor.set_shadow(self._shadow)
-            c_rows = self._tele.counter(f"routed_{replica_id}_rows_total")
+            seeded = dict(self._policy_params)
+            shadow = self._shadow
+        for pid, params in seeded.items():
+            # synchronous seed: traffic may pin this policy the moment
+            # the replica is routable
+            predictor.add_policy(pid, params)
+        if shadow is not None:
+            predictor.set_shadow(shadow)
+        c_rows = self._tele.counter(f"routed_{replica_id}_rows_total")
+        with self._lock:
+            if replica_id in self._replicas:
+                raise ValueError(f"replica {replica_id!r} already registered")
             self._replicas[replica_id] = _Replica(
                 replica_id, predictor, signals, pump, c_rows, self._clock()
             )
+            latest = dict(self._policy_params)
         pump.start()
+        # catch-up: a policy added or promoted between the seed snapshot
+        # and the table insert missed both the synchronous seed and the
+        # table-wide fan-out — publish it through the pump (latest wins)
+        for pid, params in latest.items():
+            if pid not in seeded or seeded[pid] is not params:
+                pump.publish(pid, params)
         self._flight.record("replica_added", replica=replica_id)
 
     def _publish_error(self, replica_id: str, e: Exception) -> None:
@@ -413,6 +440,9 @@ class ServingRouter:
         if rep is None:
             raise KeyError(f"unknown replica {replica_id!r}")
         rep.pump.stop()
+        # bounded join: the pump thread must be dead before the caller
+        # drains/stops the predictor, or a late publish races teardown
+        rep.pump.join(timeout=2.0)
         self._flight.record(
             "replica_retired", replica=replica_id,
             outstanding_rows=rep.outstanding_rows,
@@ -620,7 +650,7 @@ class ServingRouter:
             # gets the task before the caller hears anything
             self._c_overflow.inc()
             last_rej = task._sync_rej
-            task._sync_rej = None
+            task._sync_rej = None  # ba3cflow: disable=F1 — single-threaded window: a sync fast-reject means _admitting already dropped, so no shed callback can race this clear
         # nobody could take it: deliver ONE typed reject
         if last_rej is not None:
             self._c_exhausted.inc()
@@ -914,17 +944,20 @@ class ServingRouter:
         win_buckets: List[int] = []
         win_count = 0
         unit = 1e-6
+        with self._lock:
+            prev_last = dict(self._agg_last)
+        new_last: Dict[str, Tuple[list, int]] = {}
         for r in live:
             hist = r.last_health.get("serve_hist")
             if not hist:
                 continue
-            prev = self._agg_last.get(r.replica_id, ([], 0))[0]
+            prev = prev_last.get(r.replica_id, ([], 0))[0]
             cur = hist["buckets"]
             delta = [
                 max(0, c - (prev[i] if i < len(prev) else 0))
                 for i, c in enumerate(cur)
             ]
-            self._agg_last[r.replica_id] = (list(cur), hist["count"])
+            new_last[r.replica_id] = (list(cur), hist["count"])
             unit = hist.get("unit", unit)
             if len(delta) > len(win_buckets):
                 win_buckets.extend([0] * (len(delta) - len(win_buckets)))
@@ -942,6 +975,12 @@ class ServingRouter:
         self._agg_totals = (rows, sheds)
         total = d_rows + d_sheds
         with self._lock:
+            # _agg_last writes happen under the lock remove_replica pops
+            # it under, and only for replicas still in the table — a
+            # concurrent removal mid-tick must not resurrect its entry
+            for rid, entry in new_last.items():
+                if rid in self._replicas:
+                    self._agg_last[rid] = entry
             self._agg = {
                 "replicas_live": float(len(live)),
                 "replicas_total": float(len(reps)),

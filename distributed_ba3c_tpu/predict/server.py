@@ -995,7 +995,7 @@ class BatchedPredictor:
                     # the tap's states must outlive the staging buffer's
                     # reuse (pad rows are sliced off above for the same
                     # reason: the tap sees exactly the SERVED rows)
-                    states = states.copy()  # ba3clint: disable=A13 — eval tap, not the ingest path
+                    states = states.copy()
                 self._fire(tap, states, actions[: inf.n], inf.policy)
                 self._release_lease(inf, synced=True)
             else:

@@ -202,6 +202,122 @@ class SanitizedQueue:
         return self._q.maxsize
 
 
+def _lock_held(lock) -> bool:
+    """Best-effort 'does the CALLING thread hold this lock'.
+
+    RLocks know their owner (``_is_owned``); plain locks only know they
+    are held by someone, which is the best we can check without changing
+    the caller's lock type.
+    """
+    owned = getattr(lock, "_is_owned", None)
+    if owned is not None:
+        return bool(owned())
+    return lock.locked()
+
+
+class SanitizedGuardedDict(dict):
+    """Dict whose structural writes must happen with the guard lock held.
+
+    The serving router's replica table is read lock-free on the dispatch
+    fast path but every shape change (add/remove replica) is supposed to
+    go through ``self._lock`` — ba3cflow proves that for the code it can
+    see; this wrapper proves it for code it can't (monkeypatched tests,
+    exec'd config hooks, future callers). Reads are unrestricted.
+    """
+
+    def __init__(self, lock, name: str):
+        super().__init__()
+        self._guard = lock
+        self._name = name
+
+    def _check(self, op: str, key) -> None:
+        if not _lock_held(self._guard):
+            _report(
+                f"{self._name}: structural {op} of {key!r} without "
+                "holding the guarding lock — every table shape change "
+                "must be lock-serialized"
+            )
+
+    def __setitem__(self, key, value) -> None:
+        self._check("set", key)
+        dict.__setitem__(self, key, value)
+
+    def __delitem__(self, key) -> None:
+        self._check("delete", key)
+        dict.__delitem__(self, key)
+
+    def pop(self, key, *default):
+        self._check("pop", key)
+        return dict.pop(self, key, *default)
+
+    def popitem(self):
+        self._check("popitem", "*")
+        return dict.popitem(self)
+
+    def setdefault(self, key, default=None):
+        if key not in self:
+            self._check("create", key)
+        return dict.setdefault(self, key, default)
+
+    def update(self, *args, **kwargs):
+        self._check("update", "*")
+        dict.update(self, *args, **kwargs)
+
+    def clear(self) -> None:
+        self._check("clear", "*")
+        dict.clear(self)
+
+
+class SanitizedGuardedList(list):
+    """List whose structural writes must happen with the guard lock held
+    (ReplicaSet's ``_live`` roster). Reads are unrestricted."""
+
+    def __init__(self, lock, name: str):
+        super().__init__()
+        self._guard = lock
+        self._name = name
+
+    def _check(self, op: str) -> None:
+        if not _lock_held(self._guard):
+            _report(
+                f"{self._name}: structural {op} without holding the "
+                "guarding lock — every roster change must be "
+                "lock-serialized"
+            )
+
+    def append(self, item) -> None:
+        self._check("append")
+        list.append(self, item)
+
+    def extend(self, items) -> None:
+        self._check("extend")
+        list.extend(self, items)
+
+    def insert(self, i, item) -> None:
+        self._check("insert")
+        list.insert(self, i, item)
+
+    def remove(self, item) -> None:
+        self._check("remove")
+        list.remove(self, item)
+
+    def pop(self, *index):
+        self._check("pop")
+        return list.pop(self, *index)
+
+    def clear(self) -> None:
+        self._check("clear")
+        list.clear(self)
+
+    def __setitem__(self, i, item) -> None:
+        self._check("setitem")
+        list.__setitem__(self, i, item)
+
+    def __delitem__(self, i) -> None:
+        self._check("delitem")
+        list.__delitem__(self, i)
+
+
 def wrap_client_table(default_factory: Callable[[], object], name: str):
     """A client table: sanitized when enabled, plain defaultdict otherwise."""
     if not enabled():
@@ -214,6 +330,20 @@ def wrap_queue(q: _queue_mod.Queue, name: str):
     if not enabled():
         return q
     return SanitizedQueue(q, name)
+
+
+def wrap_guarded_dict(lock, name: str):
+    """A lock-guarded table: sanitized when enabled, plain dict otherwise."""
+    if not enabled():
+        return {}
+    return SanitizedGuardedDict(lock, name)
+
+
+def wrap_guarded_list(lock, name: str):
+    """A lock-guarded roster: sanitized when enabled, plain list otherwise."""
+    if not enabled():
+        return []
+    return SanitizedGuardedList(lock, name)
 
 
 def claim_owner(obj) -> None:

@@ -8,19 +8,31 @@
 #
 # Gate 1: ba3clint — the repo-specific AST lint suite (rule catalog in
 #         docs/static_analysis.md). Exit 1 on any unsuppressed finding.
+# Gate 1b: ba3cflow — the interprocedural concurrency & lifecycle
+#         analyzer (F1-F6, same doc): whole-repo call-graph analysis of
+#         the actor/serving planes. Exit 1 on any unsuppressed finding.
+#         Then the stale-suppression audit for BOTH tools: a disable=
+#         comment that masks nothing is itself a finding (S001).
 # Gate 2: compileall — every shipped .py must at least byte-compile.
 # Gate 3: ba3caudit — trace-level (jaxpr/HLO) invariants of the hot-path
 #         entry points against the committed audit_manifest.json (same
 #         doc). Exit 1 on any T-rule violation or manifest drift.
 #
 # CI runs exactly this script (.github/workflows/ci.yml `lint` job runs
-# gates 1-2; the `audit` job runs gate 3), so a clean local run means
-# clean CI static gates.
+# gates 1-2, the `flow` job runs gate 1b with SARIF upload; the `audit`
+# job runs gate 3), so a clean local run means clean CI static gates.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== ba3clint =="
 python -m tools.ba3clint distributed_ba3c_tpu tools scripts train.py bench.py
+
+echo "== ba3cflow =="
+python -m tools.ba3cflow
+
+echo "== suppression hygiene =="
+python -m tools.ba3clint --check-suppressions distributed_ba3c_tpu tools scripts train.py bench.py
+python -m tools.ba3cflow --check-suppressions
 
 echo "== compileall =="
 python -m compileall -q distributed_ba3c_tpu tools scripts tests train.py bench.py

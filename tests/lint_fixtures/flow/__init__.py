@@ -1,0 +1,3 @@
+"""ba3cflow fixtures: each F-rule has a *_flagged.py / *_clean.py pair,
+plus historical replays of bugs that shipped (and were later caught) in
+this repo. Never imported — the analyzer parses them as source."""

@@ -1,0 +1,35 @@
+"""F1 must stay quiet: blocking work happens outside the lock, queue ops
+are bounded, and the guarded counter is written under the lock everywhere."""
+
+import queue
+import threading
+import time
+
+
+class Worker(threading.Thread):
+
+    def __init__(self):
+        super().__init__()
+        self._stop_evt = threading.Event()
+        self._lock = threading.Lock()
+        self.inq = queue.Queue()
+        self._depth = 0
+
+    def run(self):
+        while True:
+            if self._stop_evt.is_set():
+                return
+            item = self.inq.get(timeout=0.2)
+            self._handle(item)
+            with self._lock:
+                self._depth += 1
+
+    def _handle(self, item):
+        time.sleep(0.01)
+
+    def enqueue(self, item):
+        self.inq.put(item, timeout=1.0)
+
+    def drain(self):
+        with self._lock:
+            self._depth = 0

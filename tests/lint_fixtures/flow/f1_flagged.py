@@ -1,0 +1,39 @@
+"""F1 must fire: blocking ops reachable while a lock is held, and an
+attribute guarded in one method but written bare in another."""
+
+import queue
+import threading
+import time
+
+
+class Worker(threading.Thread):
+
+    def __init__(self):
+        super().__init__()
+        self._stop_evt = threading.Event()
+        self._lock = threading.Lock()
+        self.inq = queue.Queue()
+        self._depth = 0
+
+    def run(self):
+        while True:
+            if self._stop_evt.is_set():
+                return
+            item = self.inq.get(timeout=0.2)
+            with self._lock:
+                # transitively blocking: _handle sleeps
+                self._handle(item)
+
+    def _handle(self, item):
+        time.sleep(0.1)
+        self._depth += 1
+
+    def enqueue(self, item):
+        with self._lock:
+            # direct: untimed queue put under the lock
+            self.inq.put(item)
+
+    def drain(self):
+        # guard discipline: _depth is written under _lock in _handle
+        # (always called locked) but bare here
+        self._depth = 0

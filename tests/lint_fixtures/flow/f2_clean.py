@@ -1,0 +1,22 @@
+"""F2 must stay quiet: both paths honor the same acquisition order."""
+
+import threading
+
+
+class Ledger:
+
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self.credits = 0
+        self.debits = 0
+
+    def credit(self):
+        with self._alock:
+            with self._block:
+                self.credits += 1
+
+    def debit(self):
+        with self._alock:
+            with self._block:
+                self.debits += 1

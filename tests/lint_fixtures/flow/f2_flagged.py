@@ -1,0 +1,23 @@
+"""F2 must fire: one path takes _alock then _block, the other _block
+then _alock — two threads can each hold one and wait forever."""
+
+import threading
+
+
+class Ledger:
+
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self.credits = 0
+        self.debits = 0
+
+    def credit(self):
+        with self._alock:
+            with self._block:
+                self.credits += 1
+
+    def debit(self):
+        with self._block:
+            with self._alock:
+                self.debits += 1

@@ -1,0 +1,21 @@
+"""F3 must stay quiet: the while-True body observes the stop event."""
+
+import threading
+
+
+class Pump(threading.Thread):
+
+    def __init__(self):
+        super().__init__()
+        self._stop_evt = threading.Event()
+        self.backlog = []
+
+    def run(self):
+        while True:
+            if self._stop_evt.is_set():
+                break
+            self._drain()
+
+    def _drain(self):
+        if self.backlog:
+            self.backlog.pop()

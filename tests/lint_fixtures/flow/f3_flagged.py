@@ -1,0 +1,19 @@
+"""F3 must fire: the thread body spins forever with no stop-flag check,
+break, or return — stop()/join() can never reclaim it."""
+
+import threading
+
+
+class Pump(threading.Thread):
+
+    def __init__(self):
+        super().__init__()
+        self.backlog = []
+
+    def run(self):
+        while True:
+            self._drain()
+
+    def _drain(self):
+        if self.backlog:
+            self.backlog.pop()

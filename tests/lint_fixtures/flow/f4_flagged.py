@@ -1,0 +1,23 @@
+"""F4 must fire twice: an untimed .join() inside a lock-held region, and
+a self.join() reachable from the thread's own run()."""
+
+import threading
+
+
+class Reaper(threading.Thread):
+
+    def __init__(self):
+        super().__init__()
+        self._lock = threading.Lock()
+        self.workers = []
+
+    def shutdown(self):
+        with self._lock:
+            for w in self.workers:
+                w.join()
+
+    def run(self):
+        self._finish()
+
+    def _finish(self):
+        self.join()

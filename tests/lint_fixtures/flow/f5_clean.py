@@ -1,0 +1,19 @@
+"""F5 must stay quiet: whoever starts the thread joins it."""
+
+import threading
+
+
+def _work():
+    return None
+
+
+class Owner:
+
+    def __init__(self):
+        self._t = threading.Thread(target=_work, daemon=True)
+
+    def start(self):
+        self._t.start()
+
+    def stop(self):
+        self._t.join(timeout=2.0)

@@ -1,0 +1,20 @@
+"""F5 must fire: the owner constructs and starts a thread but neither
+stops nor joins it — shutdown leaks the thread."""
+
+import threading
+
+
+def _work():
+    return None
+
+
+class Owner:
+
+    def __init__(self):
+        self._t = threading.Thread(target=_work, daemon=True)
+
+    def start(self):
+        self._t.start()
+
+    def stop(self):
+        return None

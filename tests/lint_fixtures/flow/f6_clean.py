@@ -1,0 +1,14 @@
+"""F6 must stay quiet: every attribute call resolves statically."""
+
+
+class Task:
+
+    def __init__(self):
+        self.payload = None
+
+    def cancel(self):
+        self.payload = None
+
+
+def handle(task: Task):
+    task.cancel()

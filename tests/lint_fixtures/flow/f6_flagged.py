@@ -1,0 +1,15 @@
+"""F6 must fire: a method call on a project-typed object that no class in
+the MRO defines — the call raises AttributeError at runtime."""
+
+
+class Task:
+
+    def __init__(self):
+        self.payload = None
+
+    def cancel(self):
+        self.payload = None
+
+
+def handle(task: Task):
+    task.cancle("late")

@@ -1,0 +1,26 @@
+"""Historical replay: the admission-counter decrement race.
+
+The shed callback decremented ``_admitting`` without the lock the
+admit path guards it with, so a racing decrement could be lost and the
+gate stuck counting phantom in-flight tasks. F1's guard-discipline
+facet catches exactly this shape."""
+
+import threading
+
+
+class AdmissionGate:
+
+    def __init__(self, cap):
+        self._lock = threading.Lock()
+        self._cap = cap
+        self._admitting = 0
+
+    def try_admit(self):
+        with self._lock:
+            if self._admitting >= self._cap:
+                return False
+            self._admitting += 1
+        return True
+
+    def on_shed(self):
+        self._admitting -= 1

@@ -1,0 +1,23 @@
+"""Historical replay: the ``logger.exception`` latent AttributeError.
+
+The error path of a guarded tick called a logger function that the
+project logger module never defined, so the handler that was supposed
+to contain failures raised INSIDE the except block. It sat latent for
+nine PRs because the happy path never entered the handler. F6 resolves
+the call against the module's real top-level names."""
+
+from tests.lint_fixtures.flow.replay_f6 import minilog
+
+
+def guarded_tick(tick):
+    try:
+        tick()
+    except Exception as e:
+        minilog.exception("tick failed: %r", e)
+
+
+def healthy_tick(tick):
+    try:
+        tick()
+    except Exception as e:
+        minilog.error("tick failed: %r", e)

@@ -1,0 +1,14 @@
+"""Mini logger module for the replay: info/warning/error exist,
+``exception`` does not — same surface as utils/logger at the time."""
+
+
+def info(msg, *args):
+    return None
+
+
+def warning(msg, *args):
+    return None
+
+
+def error(msg, *args):
+    return None

@@ -1,0 +1,14 @@
+"""A suppression that masks nothing — --check-suppressions must flag it."""
+
+import threading
+
+
+class Quiet:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1  # ba3cflow: disable=F2 — stale: nothing inverts here

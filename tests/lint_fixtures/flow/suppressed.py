@@ -1,0 +1,25 @@
+"""Real F1 findings masked by a trailing and a standalone suppression —
+the filtered run must be clean, the raw run must see both."""
+
+import threading
+
+
+class Admission:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._admitting = 0
+
+    def try_admit(self):
+        with self._lock:
+            if self._admitting >= 4:
+                return False
+            self._admitting += 1
+        return True
+
+    def on_shed(self):
+        self._admitting -= 1  # ba3cflow: disable=F1 — fixture: trailing form
+
+    def on_timeout(self):
+        # ba3cflow: disable=F1 — fixture: standalone form covers next line
+        self._admitting -= 1

@@ -1,0 +1,236 @@
+"""tools/ba3cflow: per-rule fixtures, historical replays, CLI contract.
+
+Mirrors the ba3clint test structure: every flow rule must (a) fire on its
+``f*_flagged.py`` fixture and (b) stay quiet on its ``f*_clean.py``
+fixture — the clean fixtures encode the concurrency idioms the real
+codebase uses (stop-event loops, snapshot-then-join, timed queue ops), so
+a rule regression that would spam the repo fails here first. The replay
+fixtures pin the analyzer to two bugs that actually shipped in this repo:
+the ``logger.exception`` latent AttributeError (F6) and the admission
+decrement race (F1). The CLI tests pin the exit-status contract CI gates
+on, and the SARIF test pins the schema the upload step consumes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.ba3clint.engine import stale_suppressions
+from tools.ba3cflow import all_rules
+from tools.ba3cflow.engine import build_context, filter_suppressed, run_rules
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures", "flow")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RULE_IDS = ["F1", "F2", "F3", "F4", "F5", "F6"]
+
+
+def _analyze(*names, suppress=True):
+    paths = [os.path.join(FIXTURES, n) for n in names]
+    ctx = build_context(paths, root=REPO_ROOT)
+    raw = run_rules(ctx, all_rules())
+    return (filter_suppressed(ctx, raw) if suppress else raw), ctx
+
+
+def _findings(name, rule_id=None, suppress=True):
+    out, _ = _analyze(name, suppress=suppress)
+    if rule_id is not None:
+        out = [f for f in out if f.rule == rule_id]
+    return out
+
+
+def _cli(*args, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.ba3cflow", *args],
+        cwd=cwd, capture_output=True, text=True,
+    )
+
+
+def _fx(name):
+    return os.path.join("tests", "lint_fixtures", "flow", name)
+
+
+# -- rule registry ----------------------------------------------------------
+
+
+def test_rule_registry_complete():
+    assert [r.id for r in all_rules()] == RULE_IDS
+    for r in all_rules():
+        assert r.id and r.name and r.summary and r.__doc__
+
+
+# -- fixture pairs ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_flagged_fixture_fires(rule_id):
+    name = f"{rule_id.lower()}_flagged.py"
+    hits = _findings(name, rule_id)
+    assert hits, f"{rule_id} produced no findings on {name}"
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_flagged_fixture_fires_only_its_own_rule(rule_id):
+    """Cross-rule noise on a flagged fixture means a rule is over-broad."""
+    name = f"{rule_id.lower()}_flagged.py"
+    other = [f for f in _findings(name) if f.rule != rule_id]
+    assert not other, other
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_clean_fixture_clean_under_every_rule(rule_id):
+    hits = _findings(f"{rule_id.lower()}_clean.py")
+    assert not hits, hits
+
+
+def test_expected_flag_counts():
+    """Pin exact counts so rules don't silently widen or narrow: F1 sees
+    the transitive sleep, the untimed put, and the unguarded write; F4
+    sees the join-under-lock and the join-on-self."""
+    assert len(_findings("f1_flagged.py", "F1")) == 3
+    assert len(_findings("f2_flagged.py", "F2")) == 1
+    assert len(_findings("f3_flagged.py", "F3")) == 1
+    assert len(_findings("f4_flagged.py", "F4")) == 2
+    assert len(_findings("f5_flagged.py", "F5")) == 1
+    assert len(_findings("f6_flagged.py", "F6")) == 1
+
+
+# -- historical replays -----------------------------------------------------
+
+
+def test_replay_admission_decrement_race_is_an_f1():
+    """PR 16's bug class: the shed path decremented the admission counter
+    without the lock the admit path guards it with."""
+    hits = _findings("replay_f1_try_admit.py", "F1")
+    assert len(hits) == 1
+    assert "on_shed" not in hits[0].message  # reported AT the bare write
+    assert "_admitting" in hits[0].message
+    assert "try_admit" in hits[0].message  # ...naming the guarded twin
+
+
+def test_replay_logger_exception_is_an_f6():
+    """PR 7's bug class: the except handler called a logger function the
+    project logger module never defined."""
+    out, _ = _analyze(
+        os.path.join("replay_f6", "caller.py"),
+        os.path.join("replay_f6", "minilog.py"),
+    )
+    assert [f.rule for f in out] == ["F6"]
+    assert "exception" in out[0].message
+    assert out[0].path.endswith("caller.py")
+
+
+# -- suppressions -----------------------------------------------------------
+
+
+def test_suppressions_silence_real_findings_both_forms():
+    raw = _findings("suppressed.py", "F1", suppress=False)
+    assert len(raw) == 2, raw  # trailing AND standalone form both land
+    assert _findings("suppressed.py") == []
+
+
+def test_docstring_mention_of_disable_is_not_a_suppression():
+    """Only real comment tokens suppress — documentation text that quotes
+    the syntax must neither mask findings nor read as stale."""
+    src = '"""uses # ba3cflow: disable=F1 like this"""\nx = 1\n'
+    from tools.ba3clint.engine import suppressions
+    assert suppressions(src, tool="ba3cflow") == {}
+    assert stale_suppressions(src, "d.py", [], "ba3cflow") == []
+
+
+def test_check_suppressions_flags_stale_comment():
+    _, ctx = _analyze("stale_suppressed.py", suppress=False)
+    (path, mod), = ctx.project.by_path.items()
+    out = stale_suppressions(mod.source, path, [], "ba3cflow")
+    assert [f.rule for f in out] == ["S001"]
+    assert "F2" in out[0].message
+
+
+# -- whole-repo gate --------------------------------------------------------
+
+
+def test_repo_is_flow_clean():
+    """The acceptance bar: the analyzer runs over the real codebase and
+    exits clean (true positives fixed, false positives suppressed with
+    justifications)."""
+    ctx = build_context(
+        [os.path.join(REPO_ROOT, "distributed_ba3c_tpu"),
+         os.path.join(REPO_ROOT, "tools")],
+        root=REPO_ROOT,
+    )
+    assert not ctx.project.broken
+    findings = filter_suppressed(ctx, run_rules(ctx, all_rules()))
+    assert findings == [], findings
+
+
+# -- engine behavior --------------------------------------------------------
+
+
+def test_syntax_error_becomes_e001_not_a_crash(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    ctx = build_context([str(bad)], root=str(tmp_path))
+    out = run_rules(ctx, all_rules())
+    assert [f.rule for f in out] == ["E001"]
+
+
+# -- CLI contract -----------------------------------------------------------
+
+
+def test_cli_exit_one_on_findings_and_zero_on_clean():
+    assert _cli(_fx("f5_flagged.py")).returncode == 1
+    assert _cli(_fx("f5_clean.py")).returncode == 0
+
+
+def test_cli_select_unknown_rule_is_usage_error():
+    r = _cli("--select", "F99", _fx("f5_clean.py"))
+    assert r.returncode == 2
+    assert "F99" in r.stderr
+
+
+def test_cli_select_narrows_rules():
+    r = _cli("--select", "F2", _fx("f5_flagged.py"))
+    assert r.returncode == 0, r.stdout
+
+
+def test_cli_json_output_parses():
+    r = _cli("--json", _fx("f3_flagged.py"))
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    assert payload and payload[0]["rule"] == "F3"
+    assert payload[0]["line"] > 0
+
+
+def test_cli_list_rules():
+    r = _cli("--list-rules")
+    assert r.returncode == 0
+    for rid in RULE_IDS:
+        assert rid in r.stdout
+
+
+def test_cli_check_suppressions_exits_one_on_stale():
+    r = _cli("--check-suppressions", _fx("stale_suppressed.py"))
+    assert r.returncode == 1
+    assert "S001" in r.stdout
+    r = _cli("--check-suppressions", _fx("suppressed.py"))
+    assert r.returncode == 0, r.stdout
+
+
+def test_cli_sarif_output(tmp_path):
+    sarif_path = tmp_path / "flow.sarif"
+    r = _cli("--sarif", str(sarif_path), _fx("f4_flagged.py"))
+    assert r.returncode == 1
+    doc = json.loads(sarif_path.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "ba3cflow"
+    rule_ids = {rd["id"] for rd in run["tool"]["driver"]["rules"]}
+    assert set(RULE_IDS) <= rule_ids
+    results = run["results"]
+    assert results and all(res["ruleId"] == "F4" for res in results)
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("f4_flagged.py")
+    assert loc["region"]["startLine"] > 0

@@ -215,3 +215,30 @@ def test_repo_tree_is_lint_clean():
     assert findings == [], "\n".join(
         f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in findings
     )
+
+
+def test_cli_sarif_output(tmp_path):
+    sarif_path = tmp_path / "lint.sarif"
+    r = _run_cli("--sarif", str(sarif_path), _fixture("a4_flagged.py"))
+    assert r.returncode == 1
+    doc = json.loads(sarif_path.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "ba3clint"
+    assert {rd["id"] for rd in run["tool"]["driver"]["rules"]} >= set(RULE_IDS)
+    assert run["results"] and run["results"][0]["ruleId"]
+
+
+def test_cli_check_suppressions(tmp_path):
+    live = tmp_path / "live.py"
+    live.write_text(
+        "import queue\n"
+        "def pull(q: 'queue.Queue'):\n"
+        "    return q.get()  # ba3clint: disable=A2 — fixture\n"
+    )
+    stale = tmp_path / "stale.py"
+    stale.write_text("x = 1  # ba3clint: disable=A2 — nothing here\n")
+    assert _run_cli("--check-suppressions", str(live)).returncode == 0
+    r = _run_cli("--check-suppressions", str(stale))
+    assert r.returncode == 1
+    assert "[S001]" in r.stdout and "A2" in r.stdout

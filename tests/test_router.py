@@ -925,3 +925,108 @@ def test_killed_real_replica_traffic_rebalances_without_wedging():
         for p in preds:
             p.stop()
             p.join(timeout=5)
+
+
+# -- lifecycle/locking regressions (found by ba3cflow) ----------------------
+
+
+def test_add_replica_seeds_policies_outside_the_router_lock():
+    """add_policy reaches jax.device_put on a real predictor; seeding a
+    grown replica must not happen under the router-wide lock (F1: a slow
+    device would wedge every dispatch and the health loop)."""
+    router, reps, clock = _router(n_replicas=1)
+    try:
+        router.update_params("v1", policy="canary")
+        lock_held = []
+
+        class _Seeded(FakeReplica):
+            def add_policy(self, pid, params):
+                lock_held.append(router._lock._is_owned())
+                super().add_policy(pid, params)
+
+        rep = _Seeded()
+        router.add_replica("r9", rep, signals=rep.signals)
+        assert lock_held, "the grown replica was never seeded"
+        assert not any(lock_held), (
+            "add_policy ran while the router lock was held"
+        )
+        assert rep.policies["canary"] == "v1"
+    finally:
+        router.stop()
+
+
+def test_add_replica_catches_up_on_params_published_during_seed():
+    """A publish that lands between the seed snapshot and the table
+    insert must still reach the new replica (via its pump), or it serves
+    a stale table until the next publish."""
+    router, reps, clock = _router(n_replicas=1)
+    try:
+        router.update_params("v1", policy="default")
+
+        class _Racy(FakeReplica):
+            def add_policy(self, pid, params):
+                # a promotion fires mid-registration, after this
+                # replica's seed snapshot was taken
+                if not self.policies.get("default"):
+                    router.update_params("v2", policy="default")
+                super().add_policy(pid, params)
+
+        rep = _Racy()
+        router.add_replica("r9", rep, signals=rep.signals)
+        deadline = time.monotonic() + 5
+        while rep.policies["default"] != "v2" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert rep.policies["default"] == "v2", (
+            "replica kept the stale seed-time params"
+        )
+    finally:
+        router.stop()
+
+
+def test_stop_joins_every_pump_thread():
+    """The router starts one publisher thread per replica; stop() must
+    join them, not orphan them (F5) — a wedged daemon thread otherwise
+    outlives the router and races interpreter teardown."""
+    router, reps, clock = _router(n_replicas=3)
+    pumps = [r.pump for r in router._replicas.values()]
+    router.stop()
+    for p in pumps:
+        assert not p.is_alive(), f"{p.name} still running after stop()"
+
+
+def test_remove_replica_joins_its_pump_thread():
+    router, reps, clock = _router(n_replicas=2)
+    try:
+        pump = router._replicas["r0"].pump
+        router.remove_replica("r0")
+        assert not pump.is_alive(), (
+            "pump thread survived remove_replica — a late publish can "
+            "race the owner's drain/stop of the predictor"
+        )
+    finally:
+        router.stop()
+
+
+def test_stale_health_tick_cannot_resurrect_removed_replica_state():
+    """The health loop snapshots the replica list, then recomputes the
+    aggregate lock-free. A removal that lands mid-tick must win: the
+    tick's writeback may not re-create the removed replica's histogram
+    state (the _agg_last entry remove_replica just popped)."""
+    router, reps, clock = _router(n_replicas=2)
+    try:
+        stale = list(router._replicas.values())  # health thread's snapshot
+        hist = {"buckets": [5, 3, 1], "count": 9, "unit": 1e-6}
+        for r in stale:
+            r.last_health = {
+                "rows_total": 10.0, "sheds_total": 0.0,
+                "serve_hist": hist,
+            }
+        router.remove_replica("r0")  # races the tick below
+        router._recompute_aggregate(stale)
+        with router._lock:
+            assert "r0" not in router._agg_last, (
+                "stale tick resurrected the removed replica's entry"
+            )
+            assert "r1" in router._agg_last
+    finally:
+        router.stop()

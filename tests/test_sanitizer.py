@@ -175,3 +175,176 @@ def test_sanitized_actor_plane_has_no_findings(tmp_path, monkeypatch):
         for p in procs:
             p.join(timeout=5)
     assert sanitizer.findings() == [], sanitizer.findings()
+
+
+# -- lock-guarded structures (the serving plane's tables) -------------------
+
+
+def test_guarded_wrappers_disabled_return_plain(monkeypatch):
+    monkeypatch.delenv("BA3C_SANITIZE", raising=False)
+    lock = threading.RLock()
+    assert type(sanitizer.wrap_guarded_dict(lock, "t")) is dict
+    assert type(sanitizer.wrap_guarded_list(lock, "l")) is list
+
+
+def test_guarded_dict_requires_lock_for_structural_writes(monkeypatch):
+    monkeypatch.setenv("BA3C_SANITIZE", "1")
+    lock = threading.RLock()
+    table = sanitizer.wrap_guarded_dict(lock, "router.replicas")
+    assert isinstance(table, sanitizer.SanitizedGuardedDict)
+    with lock:
+        table["r0"] = "rep"
+    assert "r0" in table and table["r0"] == "rep"  # lock-free reads are fine
+    with pytest.raises(sanitizer.SanitizerError):
+        table["r1"] = "rep"
+    with pytest.raises(sanitizer.SanitizerError):
+        table.pop("r0")
+    with pytest.raises(sanitizer.SanitizerError):
+        table.update({"r2": "rep"})
+    with lock:
+        assert table.pop("r0") == "rep"
+    assert len(sanitizer.findings()) == 3
+
+
+def test_guarded_dict_ignores_another_threads_hold(monkeypatch):
+    """RLock ownership is per-thread: someone ELSE holding the lock does
+    not license this thread's write."""
+    monkeypatch.setenv("BA3C_SANITIZE", "1")
+    lock = threading.RLock()
+    table = sanitizer.wrap_guarded_dict(lock, "t")
+    held, release = threading.Event(), threading.Event()
+
+    def holder():
+        with lock:
+            held.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert held.wait(5)
+    try:
+        with pytest.raises(sanitizer.SanitizerError):
+            table["k"] = 1
+    finally:
+        release.set()
+        t.join(timeout=5)
+
+
+def test_guarded_list_requires_lock_for_structural_writes(monkeypatch):
+    monkeypatch.setenv("BA3C_SANITIZE", "1")
+    lock = threading.RLock()
+    roster = sanitizer.wrap_guarded_list(lock, "replica_set.live")
+    assert isinstance(roster, sanitizer.SanitizedGuardedList)
+    with lock:
+        roster.append("r0")
+        roster.append("r1")
+    assert list(roster) == ["r0", "r1"] and "r0" in roster
+    with pytest.raises(sanitizer.SanitizerError):
+        roster.remove("r0")
+    with pytest.raises(sanitizer.SanitizerError):
+        roster.pop()
+    with pytest.raises(sanitizer.SanitizerError):
+        del roster[:]
+    with lock:
+        del roster[:]  # the close() idiom: clear in place, under the lock
+    assert list(roster) == []
+    assert len(sanitizer.findings()) == 3
+
+
+def test_sanitized_routed_serving_plane_has_no_findings(monkeypatch):
+    """The routed serving plane (ServingRouter + ReplicaSet) runs clean
+    under BA3C_SANITIZE=1 through its full lifecycle — spawn, traffic,
+    replica death, reconcile-replace, scale up/down, teardown. Every
+    structural write to the router's replica table and the set's roster
+    is lock-serialized; the sanitizer proves it at runtime."""
+    monkeypatch.setenv("BA3C_SANITIZE", "1")
+    import numpy as np
+
+    from distributed_ba3c_tpu import telemetry
+    from distributed_ba3c_tpu.orchestrate.serving import ReplicaSet
+    from distributed_ba3c_tpu.predict.router import ServingRouter
+
+    telemetry.reset_all()
+
+    class _Fake:
+        num_actions = 4
+
+        def __init__(self):
+            self.tasks = []
+            self.policies = {"default": None}
+            self.alive = True
+            self.stopped = False
+
+        def put_block_task(self, states, cb, deadline=None, policy=None,
+                           shed_callback=None, trace=None):
+            self.tasks.append((states, cb))
+            return True
+
+        def add_policy(self, pid, params):
+            self.policies[pid] = params
+
+        def update_params(self, params, policy="default"):
+            self.policies[policy] = params
+
+        def start(self):
+            pass
+
+        def stop(self):
+            self.stopped = True
+
+        def join(self, timeout=None):
+            pass
+
+        def serve(self):
+            while self.tasks:
+                states, cb = self.tasks.pop(0)
+                k = states.shape[0]
+                cb(np.zeros(k, np.int32), np.zeros(k, np.float32),
+                   np.full(k, -1.0, np.float32))
+
+        def signals(self):
+            return {
+                "alive": 1.0 if self.alive else 0.0, "rows_total": 0.0,
+                "sheds_total": 0.0, "queue_depth": 0.0, "inflight": 0.0,
+                "serve_p99_ms": 1.0,
+            }
+
+    router = ServingRouter(health_interval_s=3600.0)
+    assert isinstance(router._replicas, sanitizer.SanitizedGuardedDict)
+    made = []
+
+    def factory(idx):
+        rep = _Fake()
+        made.append(rep)
+        return rep
+
+    rs = ReplicaSet(
+        router, factory, min_replicas=2, max_replicas=4,
+        signals=lambda idx, pred: pred.signals, retire_grace_s=0.05,
+    )
+    assert isinstance(rs._live, sanitizer.SanitizedGuardedList)
+    router.replica_set = rs
+    rs.start(2)
+    router.start()
+    try:
+        served = []
+        for _ in range(4):
+            router.put_block_task(
+                np.zeros((4, 8, 8, 1), np.uint8),
+                lambda a, v, lp: served.append(1),
+            )
+        for rep in made:
+            rep.serve()
+        assert len(served) == 4
+        # replica death -> reconcile replacement exercises every
+        # structural-write path: router pop/insert, roster remove/append
+        made[0].alive = False
+        router.health_tick()
+        assert rs.reconcile()
+        rs.scale_to(3, reason="test-up")
+        rs.scale_to(2, reason="test-down")
+        assert router.live_count() == 2
+    finally:
+        router.stop()  # closes the ReplicaSet via router.replica_set
+        router.join(timeout=5)
+    assert sanitizer.findings() == [], sanitizer.findings()

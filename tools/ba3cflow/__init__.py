@@ -1,0 +1,40 @@
+"""ba3cflow: interprocedural concurrency & lifecycle analyzer.
+
+Where ba3clint reads one file at a time and ba3caudit reads jaxpr/HLO
+traces, ba3cflow reads the *call graph*: it builds a whole-repo symbol
+table over ``distributed_ba3c_tpu/`` and ``tools/``, discovers thread
+roots, and propagates lock-held and blocking-op facts along call paths.
+Rule catalog (details in docs/static_analysis.md):
+
+- **F1** blocking op (or transitively blocking call) while a lock/condition
+  is held; inconsistently lock-guarded attribute writes
+- **F2** lock-order inversion across the call graph
+- **F3** thread loop with no reachable stop-flag/stop-event check
+- **F4** join-on-self, or ``.join()`` under a lock
+- **F5** lifecycle leak: threads/pumps/servers started but never joined
+- **F6** project-API conformance: calls on project modules/objects that do
+  not exist statically
+
+Usage: ``python -m tools.ba3cflow [--json] [--sarif out.sarif]``.
+Suppress per line with ``# ba3cflow: disable=F1 — justification``.
+"""
+
+from tools.ba3clint.engine import Finding  # shared finding type
+from tools.ba3cflow.engine import FlowContext, analyze_paths, build_context, \
+    filter_suppressed, run_rules
+
+
+def all_rules():
+    from tools.ba3cflow.rules import all_flow_rules
+    return all_flow_rules()
+
+
+__all__ = [
+    "Finding",
+    "FlowContext",
+    "all_rules",
+    "analyze_paths",
+    "build_context",
+    "filter_suppressed",
+    "run_rules",
+]

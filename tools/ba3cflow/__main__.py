@@ -1,0 +1,107 @@
+"""CLI: ``python -m tools.ba3cflow [paths...]``.
+
+Exit status: 0 = clean, 1 = findings, 2 = bad usage — same contract as
+ba3clint, so scripts/check.sh and the CI ``flow`` job gate on it directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from tools.ba3clint.engine import stale_suppressions
+from tools.ba3cflow import all_rules
+from tools.ba3cflow.engine import build_context, filter_suppressed, run_rules
+
+DEFAULT_PATHS = ["distributed_ba3c_tpu", "tools"]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.ba3cflow",
+        description="Interprocedural concurrency/lifecycle analysis for the "
+        "BA3C stack (rule catalog: docs/static_analysis.md).",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=DEFAULT_PATHS,
+        help="files or directories to analyze "
+        f"(default: {' '.join(DEFAULT_PATHS)})",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit findings as JSON instead of human-readable lines",
+    )
+    parser.add_argument(
+        "--sarif",
+        metavar="FILE",
+        help="also write findings as SARIF 2.1.0 to FILE",
+    )
+    parser.add_argument(
+        "--select",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalog and exit",
+    )
+    parser.add_argument(
+        "--check-suppressions",
+        action="store_true",
+        help="flag '# ba3cflow: disable=' comments that mask no finding",
+    )
+    args = parser.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id:4s} {r.name:32s} {r.summary}")
+        return 0
+    if args.select:
+        wanted = {s.strip().upper() for s in args.select.split(",") if s.strip()}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print(
+                f"unknown rule id(s): {', '.join(sorted(unknown))}",
+                file=sys.stderr,
+            )
+            return 2
+        rules = [r for r in rules if r.id in wanted]
+
+    try:
+        ctx = build_context(args.paths)
+    except FileNotFoundError as e:
+        print(f"ba3cflow: {e}", file=sys.stderr)
+        return 2
+    raw = run_rules(ctx, rules)
+
+    if args.check_suppressions:
+        findings = []
+        for path, mod in sorted(ctx.project.by_path.items()):
+            per_file = [f for f in raw if f.path == path]
+            findings.extend(
+                stale_suppressions(mod.source, path, per_file, "ba3cflow"))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    else:
+        findings = filter_suppressed(ctx, raw)
+
+    if args.sarif:
+        from tools.sarif import write_sarif
+        write_sarif(args.sarif, findings, "ba3cflow", rules)
+    if args.json:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f"{f.path}:{f.line}:{f.col + 1}: [{f.rule}] {f.message}")
+        n = len(findings)
+        print(f"ba3cflow: {n} finding{'s' if n != 1 else ''}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,90 @@
+"""ba3cflow engine: project loading, rule driving, suppression filtering.
+
+The flow analyzer is whole-project: rules see a :class:`FlowContext` holding
+the symbol table, call graph, blocking-facts closure, and thread roots, and
+emit :class:`~tools.ba3clint.engine.Finding` objects (same dataclass as
+ba3clint, so JSON/SARIF plumbing is shared). Suppression comments use the
+``# ba3cflow: disable=F1 — justification`` spelling with the exact semantics
+of ba3clint's (trailing comment covers its line, standalone comment covers
+the next line).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from tools.ba3clint.engine import Finding, suppressions
+from tools.ba3cflow.graph import BlockingFacts, CallGraph, lock_regions, \
+    thread_roots
+from tools.ba3cflow.project import Project, THREAD_CTORS
+
+
+class FlowContext:
+    """Everything a flow rule can ask about the project."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.graph = CallGraph(project)
+        self.blocking = BlockingFacts(project, self.graph)
+        self.roots = thread_roots(project, self.graph)
+        self._regions_cache: Dict[str, list] = {}
+
+    def regions(self, fn) -> list:
+        cached = self._regions_cache.get(fn.qualname)
+        if cached is None:
+            cached = lock_regions(self.project, fn)
+            self._regions_cache[fn.qualname] = cached
+        return cached
+
+    def is_threadish_ctor(self, resolved: str) -> bool:
+        if resolved in THREAD_CTORS:
+            return True
+        return self.project.is_threadish(resolved)
+
+
+def build_context(paths: Sequence[str], root: str = ".") -> FlowContext:
+    return FlowContext(Project.load(paths, root))
+
+
+def run_rules(ctx: FlowContext, rules: Iterable) -> List[Finding]:
+    """All findings, unfiltered (suppressions NOT applied), sorted."""
+    out: List[Finding] = []
+    for path, err in sorted(ctx.project.broken.items()):
+        out.append(Finding(path, err.lineno or 1, (err.offset or 1) - 1,
+                           "E001", f"syntax error: {err.msg}"))
+    seen: Set[tuple] = set()
+    for rule in rules:
+        for f in rule.check(ctx):
+            key = (f.path, f.line, f.col, f.rule, f.message)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def filter_suppressed(ctx: FlowContext,
+                      findings: Sequence[Finding]) -> List[Finding]:
+    sup_by_path: Dict[str, Dict[int, Set[str]]] = {}
+    out: List[Finding] = []
+    for f in findings:
+        mod = ctx.project.by_path.get(f.path)
+        if mod is None:
+            out.append(f)
+            continue
+        sup = sup_by_path.get(f.path)
+        if sup is None:
+            sup = suppressions(mod.source, tool="ba3cflow")
+            sup_by_path[f.path] = sup
+        disabled = sup.get(f.line, set())
+        if "ALL" in disabled or f.rule.upper() in disabled:
+            continue
+        out.append(f)
+    return out
+
+
+def analyze_paths(paths: Sequence[str], rules: Optional[Iterable] = None,
+                  root: str = ".") -> List[Finding]:
+    from tools.ba3cflow.rules import all_flow_rules
+    ctx = build_context(paths, root)
+    return filter_suppressed(ctx, run_rules(ctx, rules or all_flow_rules()))

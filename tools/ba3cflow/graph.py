@@ -1,0 +1,511 @@
+"""ba3cflow call graph + interprocedural facts.
+
+Built on the :mod:`tools.ba3cflow.project` symbol table:
+
+- **call resolution**: each ``ast.Call`` in each function resolves to zero or
+  more project functions. Receivers are typed from ``self``, annotated
+  parameters, local ``x = Cls(...)`` assignments, and class attribute types
+  (``self.pump.publish`` → ``LatestWinsPump.publish``). Unknown receivers
+  resolve to nothing — rules never guess.
+- **thread roots**: functions that execute on a non-main thread — ``run()``
+  of ``threading.Thread`` subclasses, ``target=`` of thread ctors, and the
+  first positional callable of ``LoopThread``.
+- **lock regions**: ``with <lock>:`` blocks with a stable lock identity
+  (``Class.attr`` via :meth:`Project.canonical_lock`).
+- **blocking facts**: per-function direct blocking operations (unbounded
+  queue ops, bare socket recv/send, ``time.sleep``, untimed ``.wait()``,
+  subprocess waits, device puts/syncs) and their transitive closure over the
+  call graph, with a witness path for diagnostics.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.ba3clint.engine import dotted_name
+from tools.ba3cflow.project import (
+    ClassInfo,
+    FunctionInfo,
+    LOCK_CTORS,
+    Project,
+    THREAD_CTORS,
+)
+
+# --------------------------------------------------------------------------
+# receiver typing
+# --------------------------------------------------------------------------
+
+
+def local_types(project: Project, fn: FunctionInfo) -> Dict[str, str]:
+    """Best-effort map of local/param name -> canonical dotted class.
+
+    Sources: ``self``, annotated parameters, ``x = Cls(...)`` and
+    ``x: Cls = ...`` assignments, and ``for x in self.<list-of-T>`` loops
+    (element types recorded by list-literal ctor scans below).
+    """
+    mod = project.module_of(fn)
+    out: Dict[str, str] = {}
+    ci = project.class_of(fn)
+    if ci is not None:
+        out["self"] = ci.qualname
+    args = fn.node.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        if a.annotation is not None:
+            ty = _ann_dotted(a.annotation)
+            if ty:
+                out[a.arg] = mod.resolve(ty)
+    for sub in ast.walk(fn.node):
+        if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+            ctor = dotted_name(sub.value.func)
+            if not ctor:
+                continue
+            resolved = mod.resolve(ctor)
+            if project.find_class(resolved) is None and \
+                    resolved not in THREAD_CTORS and resolved not in LOCK_CTORS:
+                continue
+            for t in sub.targets:
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, resolved)
+        elif isinstance(sub, ast.AnnAssign) and isinstance(sub.target,
+                                                           ast.Name):
+            ty = _ann_dotted(sub.annotation)
+            if ty:
+                out.setdefault(sub.target.id, mod.resolve(ty))
+    return out
+
+
+def _ann_dotted(ann: ast.AST) -> Optional[str]:
+    from tools.ba3cflow.project import ann_to_dotted
+    return ann_to_dotted(ann)
+
+
+def receiver_class(project: Project, fn: FunctionInfo,
+                   expr: ast.AST,
+                   locals_: Optional[Dict[str, str]] = None
+                   ) -> Optional[ClassInfo]:
+    """Type of an expression used as a method receiver, or None.
+
+    Handles ``self``, typed locals/params, and one level of typed attribute
+    access (``self.pump`` / ``task._lock``'s owner, ``rep.pump``).
+    """
+    if locals_ is None:
+        locals_ = local_types(project, fn)
+    if isinstance(expr, ast.Name):
+        return project.resolve_class(fn.modname, locals_.get(expr.id))
+    if isinstance(expr, ast.Attribute):
+        base = receiver_class(project, fn, expr.value, locals_)
+        if base is not None:
+            for c in project.mro(base):
+                ty = c.attr_types.get(expr.attr)
+                if ty:
+                    return project.resolve_class(c.modname, ty)
+    return None
+
+
+# --------------------------------------------------------------------------
+# call resolution
+# --------------------------------------------------------------------------
+
+
+#: method names too generic for closed-world duck resolution — resolving
+#: ``anything.get(...)`` to every project ``get`` would drown the graph
+_DUCK_BLACKLIST = {
+    "get", "put", "run", "stop", "start", "close", "join", "send", "recv",
+    "update", "reset", "step", "tick", "flush", "wait", "clear", "pop",
+    "add", "remove", "append", "items", "values", "keys", "info", "warn",
+    "error", "debug", "exception", "inc", "dec", "set", "record", "gauge",
+    "observe", "write", "read", "next", "emit", "load", "save", "copy",
+    "size", "count", "name", "result", "cancel", "submit", "done",
+    "publish", "apply", "snapshot", "stopped", "main", "state", "render",
+    "acquire", "release", "locked",  # lock protocol: never duck-resolve
+}
+_DUCK_MAX_DEFINERS = 3
+
+
+def resolve_call(project: Project, fn: FunctionInfo, call: ast.Call,
+                 locals_: Optional[Dict[str, str]] = None,
+                 duck: bool = False) -> List[FunctionInfo]:
+    """Resolve one call site to project functions (possibly empty).
+
+    With ``duck=True``, a method call whose receiver type is unknown falls
+    back to closed-world duck typing: if the method name is distinctive
+    (not in the generic blacklist) and defined by at most
+    ``_DUCK_MAX_DEFINERS`` project classes, the call resolves to ALL of
+    them. Sound for may-analyses (blocking/join closures), too imprecise
+    for must-style checks like F6 — callers opt in explicitly.
+    """
+    if locals_ is None:
+        locals_ = local_types(project, fn)
+    mod = project.module_of(fn)
+    func = call.func
+
+    if isinstance(func, ast.Name):
+        resolved = mod.resolve(func.id)
+        # module-local or imported function
+        target = project.functions.get(resolved) or \
+            project.functions.get(f"{fn.modname}.{func.id}")
+        if target is not None:
+            return [target]
+        # class construction -> __init__
+        ci = project.find_class(resolved) or \
+            project.find_class(f"{fn.modname}.{func.id}")
+        if ci is not None:
+            init = project.find_method(ci, "__init__")
+            return [init] if init is not None else []
+        return []
+
+    if isinstance(func, ast.Attribute):
+        # module attribute call: logger.info(...), serving.welch_z(...)
+        base_dotted = dotted_name(func.value)
+        if base_dotted:
+            canon = mod.resolve(base_dotted)
+            m = project.find_module(canon)
+            if m is not None:
+                target = m.functions.get(f"{m.modname}.{func.attr}")
+                if target is not None:
+                    return [target]
+                ci = m.classes.get(func.attr)
+                if ci is not None:
+                    init = project.find_method(ci, "__init__")
+                    return [init] if init is not None else []
+                return []
+        # typed receiver: self.m(), task.cancel(), self.pump.publish()
+        rc = receiver_class(project, fn, func.value, locals_)
+        if rc is not None:
+            target = project.find_method(rc, func.attr)
+            if target is not None:
+                return [target]
+            return []
+        if duck and func.attr not in _DUCK_BLACKLIST:
+            definers = project.method_index.get(func.attr, [])
+            if 0 < len(definers) <= _DUCK_MAX_DEFINERS:
+                return list(definers)
+    return []
+
+
+class CallGraph:
+    """Forward call graph over a :class:`Project`, with call-site nodes."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        #: caller qualname -> [(callee FunctionInfo, ast.Call node)]
+        self.edges: Dict[str, List[Tuple[FunctionInfo, ast.Call]]] = {}
+        for fn in project.functions.values():
+            locals_ = local_types(project, fn)
+            out: List[Tuple[FunctionInfo, ast.Call]] = []
+            for sub in ast.walk(fn.node):
+                if isinstance(sub, ast.Call):
+                    for tgt in resolve_call(project, fn, sub, locals_,
+                                            duck=True):
+                        out.append((tgt, sub))
+            self.edges[fn.qualname] = out
+
+    def callees(self, qual: str) -> List[Tuple[FunctionInfo, ast.Call]]:
+        return self.edges.get(qual, [])
+
+    def reachable(self, roots: Sequence[str],
+                  max_depth: int = 64) -> Set[str]:
+        seen: Set[str] = set()
+        frontier = list(roots)
+        depth = 0
+        while frontier and depth < max_depth:
+            nxt: List[str] = []
+            for q in frontier:
+                if q in seen:
+                    continue
+                seen.add(q)
+                nxt.extend(t.qualname for t, _ in self.callees(q))
+            frontier = nxt
+            depth += 1
+        return seen
+
+
+# --------------------------------------------------------------------------
+# thread roots
+# --------------------------------------------------------------------------
+
+
+class ThreadRoot:
+    """A function that executes on a spawned thread."""
+
+    __slots__ = ("fn", "via", "site")
+
+    def __init__(self, fn: FunctionInfo, via: str, site: ast.AST):
+        self.fn = fn        # the root function
+        self.via = via      # "run-method" | "target" | "loop-fn"
+        self.site = site    # node to report against
+
+
+def thread_roots(project: Project, graph: CallGraph) -> List[ThreadRoot]:
+    roots: List[ThreadRoot] = []
+    seen: Set[str] = set()
+
+    def add(fn: Optional[FunctionInfo], via: str, site: ast.AST) -> None:
+        if fn is not None and fn.qualname not in seen:
+            seen.add(fn.qualname)
+            roots.append(ThreadRoot(fn, via, site))
+
+    # run() of Thread subclasses
+    for ci in project.classes.values():
+        if project.is_threadish(ci):
+            run = ci.methods.get("run")
+            add(run, "run-method", run.node if run else ci.node)
+
+    # target= of thread-like ctors; LoopThread(func)
+    for fn in project.functions.values():
+        mod = project.module_of(fn)
+        locals_ = local_types(project, fn)
+        for sub in ast.walk(fn.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            ctor = dotted_name(sub.func)
+            if not ctor:
+                continue
+            resolved = mod.resolve(ctor)
+            ci = project.find_class(resolved)
+            is_thread_ctor = resolved in THREAD_CTORS or (
+                ci is not None and project.is_threadish(ci))
+            if not is_thread_ctor:
+                continue
+            for kw in sub.keywords:
+                if kw.arg == "target":
+                    for tgt in _callable_targets(project, fn, kw.value,
+                                                 locals_):
+                        add(tgt, "target", sub)
+            if ci is not None and ci.name == "LoopThread" and sub.args:
+                for tgt in _callable_targets(project, fn, sub.args[0],
+                                             locals_):
+                    add(tgt, "loop-fn", sub)
+    return roots
+
+
+def _callable_targets(project: Project, fn: FunctionInfo, expr: ast.AST,
+                      locals_: Dict[str, str]) -> List[FunctionInfo]:
+    """Resolve a callable-valued expression (``self._loop``, ``fn_name``)."""
+    if isinstance(expr, ast.Attribute):
+        rc = receiver_class(project, fn, expr.value, locals_)
+        if rc is not None:
+            tgt = project.find_method(rc, expr.attr)
+            return [tgt] if tgt is not None else []
+    elif isinstance(expr, ast.Name):
+        mod = project.module_of(fn)
+        tgt = project.functions.get(mod.resolve(expr.id)) or \
+            project.functions.get(f"{fn.modname}.{expr.id}")
+        return [tgt] if tgt is not None else []
+    return []
+
+
+# --------------------------------------------------------------------------
+# lock regions
+# --------------------------------------------------------------------------
+
+
+class LockRegion:
+    """One ``with <lock>:`` block inside a function."""
+
+    __slots__ = ("lock_id", "node", "fn")
+
+    def __init__(self, lock_id: str, node: ast.With, fn: FunctionInfo):
+        self.lock_id = lock_id
+        self.node = node
+        self.fn = fn
+
+
+_LOCKISH_HINTS = ("lock", "mutex", "cond")
+
+
+def _lock_identity(project: Project, fn: FunctionInfo, expr: ast.AST,
+                   locals_: Dict[str, str]) -> Optional[str]:
+    """Stable identity of a with-context expression that is a lock, or None.
+
+    A receiver attribute is lock-like when its inferred type is a
+    ``threading`` lock/condition ctor, or (fallback) when its name carries a
+    lock-ish hint (``_lock``, ``_cond``). Identity is ``OwnerClass.attr``
+    via :meth:`Project.canonical_lock` when the owner is known, else a
+    module-scoped textual identity.
+    """
+    if isinstance(expr, ast.Attribute):
+        owner = receiver_class(project, fn, expr.value, locals_)
+        attr = expr.attr
+        if owner is not None:
+            ty = None
+            real_attr = owner.lock_aliases.get(attr, attr)
+            for c in project.mro(owner):
+                ty = c.attr_types.get(real_attr)
+                if ty:
+                    break
+            if ty in LOCK_CTORS or any(h in attr.lower()
+                                       for h in _LOCKISH_HINTS):
+                return project.canonical_lock(owner, attr)
+            return None
+        if any(h in attr.lower() for h in _LOCKISH_HINTS):
+            nm = dotted_name(expr)
+            return f"{fn.modname}:{nm or attr}"
+        return None
+    if isinstance(expr, ast.Name):
+        ty = locals_.get(expr.id)
+        if ty in LOCK_CTORS or any(h in expr.id.lower()
+                                   for h in _LOCKISH_HINTS):
+            return f"{fn.modname}:{expr.id}"
+    return None
+
+
+def lock_regions(project: Project, fn: FunctionInfo,
+                 locals_: Optional[Dict[str, str]] = None) -> List[LockRegion]:
+    if locals_ is None:
+        locals_ = local_types(project, fn)
+    out: List[LockRegion] = []
+    for sub in ast.walk(fn.node):
+        if not isinstance(sub, ast.With):
+            continue
+        for item in sub.items:
+            lid = _lock_identity(project, fn, item.context_expr, locals_)
+            if lid is not None:
+                out.append(LockRegion(lid, sub, fn))
+    return out
+
+
+def nodes_under(region: ast.With) -> Iterator[ast.AST]:
+    for stmt in region.body:
+        yield from ast.walk(stmt)
+
+
+# --------------------------------------------------------------------------
+# blocking facts
+# --------------------------------------------------------------------------
+
+
+class BlockingOp:
+    """One potentially unbounded blocking operation."""
+
+    __slots__ = ("kind", "node", "detail")
+
+    def __init__(self, kind: str, node: ast.AST, detail: str):
+        self.kind = kind
+        self.node = node
+        self.detail = detail
+
+
+_QUEUEISH = ("queue", "_queue", "q", "inq", "outq", "input_queue",
+             "output_queue", "tasks", "results")
+_SOCKISH = ("sock", "socket", "dealer", "router_sock", "pull", "push", "sub",
+            "pub", "rep", "req")
+_PROCISH = ("proc", "process", "popen", "child")
+_WAITABLE_HINTS = ("evt", "event", "cond", "ready", "done", "stop")
+
+#: canonical dotted calls that synchronize with a device (compile/transfer):
+#: seconds-long under compilation, so "blocking" for lock-held purposes.
+_DEVICE_CALLS = {
+    "jax.device_put",
+    "jax.block_until_ready",
+    "jax.device_get",
+}
+
+
+def _last_name(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _has_bound(call: ast.Call) -> bool:
+    """timeout= / block=False / zmq flags present -> bounded, not blocking."""
+    for kw in call.keywords:
+        if kw.arg in ("timeout", "flags"):
+            return True
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    return False
+
+
+def direct_blocking_ops(project: Project, fn: FunctionInfo) -> List[BlockingOp]:
+    mod = project.module_of(fn)
+    out: List[BlockingOp] = []
+    for sub in ast.walk(fn.node):
+        if not isinstance(sub, ast.Call):
+            continue
+        func = sub.func
+        resolved = None
+        nm = dotted_name(func)
+        if nm:
+            resolved = mod.resolve(nm)
+        if resolved == "time.sleep":
+            out.append(BlockingOp("sleep", sub, "time.sleep"))
+            continue
+        if resolved in _DEVICE_CALLS:
+            out.append(BlockingOp("device", sub, resolved))
+            continue
+        if resolved and resolved.startswith("subprocess.") and \
+                resolved.split(".")[-1] in ("run", "check_call",
+                                            "check_output", "call") and \
+                not _has_bound(sub):
+            out.append(BlockingOp("subprocess", sub, resolved))
+            continue
+        if not isinstance(func, ast.Attribute):
+            continue
+        meth = func.attr
+        recv = _last_name(func.value)
+        recv_l = (recv or "").lower()
+        if meth in ("get", "put") and not _has_bound(sub) and any(
+                recv_l == h or recv_l.endswith(h) for h in _QUEUEISH):
+            out.append(BlockingOp("queue", sub, f"{recv}.{meth} (untimed)"))
+        elif meth in ("recv", "recv_multipart", "send", "send_multipart",
+                      "recv_pyobj", "send_pyobj") and not sub.args and \
+                not _has_bound(sub) and any(h in recv_l for h in _SOCKISH):
+            out.append(BlockingOp("socket", sub, f"{recv}.{meth} (bare)"))
+        elif meth == "wait" and not sub.args and not _has_bound(sub):
+            if any(h in recv_l for h in _WAITABLE_HINTS):
+                out.append(BlockingOp("wait", sub, f"{recv}.wait (untimed)"))
+            elif any(recv_l == h or recv_l.endswith(h) for h in _PROCISH):
+                out.append(BlockingOp("proc-wait", sub, f"{recv}.wait"))
+        elif meth == "communicate" and not _has_bound(sub) and any(
+                recv_l == h or recv_l.endswith(h) for h in _PROCISH):
+            out.append(BlockingOp("proc-wait", sub, f"{recv}.communicate"))
+        elif meth == "block_until_ready":
+            out.append(BlockingOp("device", sub, f"{recv}.block_until_ready"))
+        elif meth == "flush" and not _has_bound(sub) and not sub.args and \
+                recv_l.endswith("pump"):
+            out.append(BlockingOp("wait", sub, f"{recv}.flush (untimed)"))
+    return out
+
+
+class BlockingFacts:
+    """Transitive may-block closure with witness paths."""
+
+    def __init__(self, project: Project, graph: CallGraph):
+        self.project = project
+        self.graph = graph
+        self.direct: Dict[str, List[BlockingOp]] = {}
+        for fn in project.functions.values():
+            ops = direct_blocking_ops(project, fn)
+            if ops:
+                self.direct[fn.qualname] = ops
+        #: qualname -> (witness chain [qualnames], terminal BlockingOp)
+        self.closure: Dict[str, Tuple[List[str], BlockingOp]] = {}
+        self._fixpoint()
+
+    def _fixpoint(self) -> None:
+        for q, ops in self.direct.items():
+            self.closure[q] = ([q], ops[0])
+        changed = True
+        while changed:
+            changed = False
+            for q, callees in self.graph.edges.items():
+                if q in self.closure:
+                    continue
+                for tgt, _node in callees:
+                    hit = self.closure.get(tgt.qualname)
+                    if hit is not None:
+                        chain, op = hit
+                        if q not in chain and len(chain) < 12:
+                            self.closure[q] = ([q] + chain, op)
+                            changed = True
+                            break
+        # (paths are shortest-ish, not minimal — good enough for messages)
+
+    def may_block(self, qual: str) -> Optional[Tuple[List[str], BlockingOp]]:
+        return self.closure.get(qual)

@@ -1,0 +1,410 @@
+"""ba3cflow project model: whole-repo symbol table.
+
+ba3clint sees one file at a time; ba3cflow's rules need to answer questions
+like "what class is ``task`` in this method?" and "does ``utils.logger``
+define ``exception``?" — so this module parses every file under the analyzed
+roots once and builds:
+
+- a module table keyed by dotted name (``distributed_ba3c_tpu.pod.cache``),
+  each with its import-alias map and top-level name set;
+- a class table with resolved base chains, per-method nodes, ``self.x``
+  attribute inventory, and best-effort attribute *types* (``self._pump =
+  LatestWinsPump(...)`` records ``pump -> <qual of LatestWinsPump>``);
+- a function table keyed by qualified name (``mod.Class.method`` /
+  ``mod.func``).
+
+Everything downstream (callgraph, rules) resolves names through this table
+and degrades gracefully: an unresolvable receiver means "unknown", never a
+guess. Heuristics over proofs, same contract as ba3clint.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.ba3clint.engine import annotate_parents, dotted_name, iter_py_files
+
+#: bases (canonical dotted) that make a class "thread-like": instances own an
+#: OS thread/process and must be stopped AND joined.
+THREAD_BASES = {
+    "threading.Thread",
+    "multiprocessing.Process",
+}
+
+#: canonical dotted ctors that are thread-like regardless of the class table
+#: (covers ``threading.Thread(target=...)`` style construction).
+THREAD_CTORS = {
+    "threading.Thread",
+    "multiprocessing.Process",
+}
+
+#: canonical dotted names whose calls produce lock-like objects.
+LOCK_CTORS = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "multiprocessing.Lock",
+    "multiprocessing.RLock",
+    "multiprocessing.Condition",
+}
+
+
+class FunctionInfo:
+    """One function or method definition."""
+
+    __slots__ = ("qualname", "modname", "cls", "name", "node", "path")
+
+    def __init__(self, qualname: str, modname: str, cls: Optional[str],
+                 node: ast.FunctionDef, path: str):
+        self.qualname = qualname
+        self.modname = modname
+        self.cls = cls  # simple class name, or None for module functions
+        self.name = node.name
+        self.node = node
+        self.path = path
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<fn {self.qualname}>"
+
+
+class ClassInfo:
+    """One class definition plus facts mined from its methods."""
+
+    def __init__(self, qualname: str, modname: str, node: ast.ClassDef,
+                 path: str):
+        self.qualname = qualname
+        self.modname = modname
+        self.name = node.name
+        self.node = node
+        self.path = path
+        #: canonical dotted base names (resolved through imports)
+        self.bases: List[str] = []
+        self.methods: Dict[str, FunctionInfo] = {}
+        #: every attribute name assigned as ``self.X = ...`` anywhere, plus
+        #: __slots__ entries and class-body assignments
+        self.attrs: Set[str] = set()
+        #: attr -> canonical dotted type when inferable (ctor call or
+        #: annotation); lock attrs map to the LOCK_CTORS entry
+        self.attr_types: Dict[str, str] = {}
+        #: attr aliases: ``self._ready = threading.Condition(self._lock)``
+        #: makes _ready and _lock the SAME lock for ordering purposes
+        self.lock_aliases: Dict[str, str] = {}
+        #: True when the class body/methods use setattr/getattr/__getattr__
+        #: on self — attribute conformance checks must stand down
+        self.dynamic_attrs: bool = False
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<class {self.qualname}>"
+
+
+class ModuleSyms:
+    """One module: imports, top-level names, functions, classes."""
+
+    def __init__(self, modname: str, path: str, tree: ast.Module, source: str):
+        self.modname = modname
+        self.path = path
+        self.tree = tree
+        self.source = source
+        #: local alias -> canonical dotted origin (same semantics as
+        #: ba3clint.ModuleInfo, duplicated here so the project model does not
+        #: require per-file ModuleInfo objects)
+        self.imports: Dict[str, str] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        #: every name bound at module top level (defs, assigns, imports)
+        self.toplevel: Set[str] = set()
+        #: module defines __getattr__ → conformance checks stand down
+        self.has_module_getattr: bool = False
+
+    def resolve(self, name: str) -> str:
+        """Canonicalize a dotted name's head through this module's imports."""
+        head, _, rest = name.partition(".")
+        origin = self.imports.get(head)
+        if origin is None:
+            return name
+        return f"{origin}.{rest}" if rest else origin
+
+
+def _module_name(path: str, root: str) -> str:
+    rel = os.path.relpath(path, root)
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    parts = rel.split(os.sep)
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _collect_imports(mod: ModuleSyms) -> None:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    mod.imports[a.asname] = a.name
+                else:
+                    head = a.name.split(".")[0]
+                    mod.imports.setdefault(head, head)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                mod.imports[a.asname or a.name] = f"{node.module}.{a.name}"
+
+
+def _collect_toplevel(mod: ModuleSyms) -> None:
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            mod.toplevel.add(node.name)
+            if node.name == "__getattr__":
+                mod.has_module_getattr = True
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    mod.toplevel.add(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for el in t.elts:
+                        if isinstance(el, ast.Name):
+                            mod.toplevel.add(el.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                            ast.Name):
+            mod.toplevel.add(node.target.id)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                mod.toplevel.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                mod.toplevel.add(a.asname or a.name)
+        elif isinstance(node, (ast.If, ast.Try)):
+            # names bound under TYPE_CHECKING / try-import guards still exist
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.FunctionDef, ast.ClassDef)):
+                    mod.toplevel.add(sub.name)
+                elif isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            mod.toplevel.add(t.id)
+                elif isinstance(sub, (ast.Import, ast.ImportFrom)):
+                    for a in sub.names:
+                        mod.toplevel.add((a.asname or a.name).split(".")[0])
+
+
+def ann_to_dotted(ann: ast.AST) -> Optional[str]:
+    """``x: Foo`` / ``x: "Foo"`` / ``x: Optional[Foo]`` -> ``Foo``."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.Subscript):
+        # Optional[Foo] / list[Foo]: take the (first) parameter for Optional,
+        # otherwise bail — container element types are handled separately.
+        base = dotted_name(ann.value)
+        if base and base.split(".")[-1] in {"Optional"}:
+            return ann_to_dotted(ann.slice)
+        return None
+    return dotted_name(ann)
+
+
+def _collect_class_facts(mod: ModuleSyms, cls: ClassInfo) -> None:
+    node = cls.node
+    for b in node.bases:
+        nm = dotted_name(b)
+        if nm:
+            cls.bases.append(mod.resolve(nm))
+    for stmt in node.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = f"{mod.modname}.{cls.name}.{stmt.name}"
+            fi = FunctionInfo(qual, mod.modname, cls.name, stmt, mod.path)
+            cls.methods[stmt.name] = fi
+            mod.functions[qual] = fi
+            if stmt.name in ("__getattr__", "__getattribute__"):
+                cls.dynamic_attrs = True
+        elif isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    cls.attrs.add(t.id)
+                    if t.id == "__slots__" and isinstance(
+                            stmt.value, (ast.Tuple, ast.List)):
+                        for el in stmt.value.elts:
+                            if isinstance(el, ast.Constant) and isinstance(
+                                    el.value, str):
+                                cls.attrs.add(el.value)
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target,
+                                                            ast.Name):
+            cls.attrs.add(stmt.target.id)
+
+    # mine methods for self.X facts
+    for m in cls.methods.values():
+        for sub in ast.walk(m.node):
+            if isinstance(sub, ast.Call):
+                fn = dotted_name(sub.func)
+                if fn in ("setattr", "getattr") and sub.args and isinstance(
+                        sub.args[0], ast.Name) and sub.args[0].id == "self":
+                    cls.dynamic_attrs = True
+            if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target])
+                value = sub.value
+                for t in targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    cls.attrs.add(t.attr)
+                    ann = getattr(sub, "annotation", None)
+                    if ann is not None:
+                        ty = ann_to_dotted(ann)
+                        if ty:
+                            cls.attr_types.setdefault(t.attr, mod.resolve(ty))
+                    if isinstance(value, ast.Call):
+                        ctor = dotted_name(value.func)
+                        if ctor:
+                            resolved = mod.resolve(ctor)
+                            cls.attr_types.setdefault(t.attr, resolved)
+                            # Condition(self._lock) shares its lock: alias it
+                            if (resolved.split(".")[-1] == "Condition"
+                                    and value.args):
+                                arg = dotted_name(value.args[0])
+                                if arg and arg.startswith("self."):
+                                    cls.lock_aliases[t.attr] = (
+                                        arg.split(".", 1)[1])
+
+
+class Project:
+    """The whole-repo symbol table."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleSyms] = {}
+        self.by_path: Dict[str, ModuleSyms] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        #: method name -> defining FunctionInfos (closed-world duck typing)
+        self.method_index: Dict[str, List[FunctionInfo]] = {}
+        #: files that failed to parse: path -> SyntaxError
+        self.broken: Dict[str, SyntaxError] = {}
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def load(cls, paths: Sequence[str], root: str = ".") -> "Project":
+        proj = cls()
+        for path in iter_py_files(paths):
+            proj._add_file(path, root)
+        proj._link()
+        return proj
+
+    def _add_file(self, path: str, root: str) -> None:
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            tree = annotate_parents(ast.parse(source, filename=path))
+        except SyntaxError as e:
+            self.broken[path] = e
+            return
+        modname = _module_name(path, root)
+        mod = ModuleSyms(modname, path, tree, source)
+        _collect_imports(mod)
+        _collect_toplevel(mod)
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{modname}.{stmt.name}"
+                mod.functions[qual] = FunctionInfo(qual, modname, None, stmt,
+                                                   path)
+            elif isinstance(stmt, ast.ClassDef):
+                ci = ClassInfo(f"{modname}.{stmt.name}", modname, stmt, path)
+                _collect_class_facts(mod, ci)
+                mod.classes[stmt.name] = ci
+        self.modules[modname] = mod
+        self.by_path[path] = mod
+
+    def _link(self) -> None:
+        for mod in self.modules.values():
+            self.functions.update(mod.functions)
+            for ci in mod.classes.values():
+                self.classes[ci.qualname] = ci
+                for name, fi in ci.methods.items():
+                    self.method_index.setdefault(name, []).append(fi)
+
+    # -- lookup ------------------------------------------------------------
+
+    def module_of(self, fn: FunctionInfo) -> ModuleSyms:
+        return self.modules[fn.modname]
+
+    def find_module(self, dotted: str) -> Optional[ModuleSyms]:
+        return self.modules.get(dotted)
+
+    def find_class(self, dotted: Optional[str]) -> Optional[ClassInfo]:
+        """Resolve a canonical dotted name to a project class, tolerating
+        both ``pkg.mod.Cls`` and re-export styles."""
+        if not dotted:
+            return None
+        return self.classes.get(dotted)
+
+    def resolve_class(self, modname: str, dotted: Optional[str]
+                      ) -> Optional[ClassInfo]:
+        """find_class with a fallback for module-local bare names: a base
+        or annotation naming a sibling class resolves through no import, so
+        try ``modname.dotted`` too."""
+        if not dotted:
+            return None
+        return self.classes.get(dotted) or \
+            self.classes.get(f"{modname}.{dotted}")
+
+    def class_of(self, fn: FunctionInfo) -> Optional[ClassInfo]:
+        if fn.cls is None:
+            return None
+        return self.modules[fn.modname].classes.get(fn.cls)
+
+    def mro(self, ci: ClassInfo) -> Iterator[ClassInfo]:
+        """Linearized project-class ancestry (self first, bases depth-first;
+        external bases are skipped — use :meth:`external_bases`)."""
+        seen: Set[str] = set()
+        stack = [ci]
+        while stack:
+            cur = stack.pop(0)
+            if cur.qualname in seen:
+                continue
+            seen.add(cur.qualname)
+            yield cur
+            for b in cur.bases:
+                bi = self.resolve_class(cur.modname, b)
+                if bi is not None:
+                    stack.append(bi)
+
+    def external_bases(self, ci: ClassInfo) -> Set[str]:
+        """Canonical dotted bases (transitively) that are NOT project classes."""
+        out: Set[str] = set()
+        for c in self.mro(ci):
+            for b in c.bases:
+                if self.resolve_class(c.modname, b) is None:
+                    out.add(b)
+        return out
+
+    def find_method(self, ci: ClassInfo, name: str) -> Optional[FunctionInfo]:
+        for c in self.mro(ci):
+            m = c.methods.get(name)
+            if m is not None:
+                return m
+        return None
+
+    def is_threadish(self, ci_or_dotted) -> bool:
+        """Does this class (or canonical dotted ctor name) own an OS thread?"""
+        if isinstance(ci_or_dotted, str):
+            if ci_or_dotted in THREAD_CTORS:
+                return True
+            ci = self.find_class(ci_or_dotted)
+        else:
+            ci = ci_or_dotted
+        if ci is None:
+            return False
+        return bool(self.external_bases(ci) & THREAD_BASES)
+
+    def canonical_lock(self, ci: ClassInfo, attr: str) -> str:
+        """Stable identity for ``self.<attr>`` as a lock, following
+        Condition-shares-lock aliases, keyed on the DEFINING class so
+        subclasses agree."""
+        attr = ci.lock_aliases.get(attr, attr)
+        for c in self.mro(ci):
+            if attr in c.attrs:
+                return f"{c.qualname}.{attr}"
+        return f"{ci.qualname}.{attr}"

@@ -1,0 +1,796 @@
+"""ba3cflow rules F1–F6: interprocedural concurrency & lifecycle hazards.
+
+Each rule is a class with ``id``/``name``/``summary`` and a
+``check(ctx)`` generator over a :class:`~tools.ba3cflow.engine.FlowContext`
+(whole-project view), mirroring the ba3clint rule contract but at call-graph
+granularity. False positives are handled at the use site with
+``# ba3cflow: disable=Fn — justification``, never by widening a carve-out
+here: the rules stay honest and the invariant becomes visible in the code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.ba3clint.engine import Finding, dotted_name
+from tools.ba3cflow.graph import (
+    BlockingOp,
+    lock_regions,
+    local_types,
+    nodes_under,
+    receiver_class,
+    resolve_call,
+)
+from tools.ba3cflow.project import ClassInfo, FunctionInfo
+
+
+class FlowRule:
+    """Base class: subclasses set ``id``/``name``/``summary`` and ``check``."""
+
+    id: str = ""
+    name: str = ""
+    summary: str = ""
+
+    def check(self, ctx) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def _finding(rule: FlowRule, fn: FunctionInfo, node: ast.AST,
+             message: str) -> Finding:
+    return Finding(fn.path, getattr(node, "lineno", 1),
+                   getattr(node, "col_offset", 0), rule.id, message)
+
+
+def _short(qual: str) -> str:
+    """Trim the package prefix for readable messages."""
+    parts = qual.split(".")
+    return ".".join(parts[-3:]) if len(parts) > 3 else qual
+
+
+# --------------------------------------------------------------------------
+# F1: blocking while a lock/condition is held + guarded-field discipline
+# --------------------------------------------------------------------------
+
+
+#: container-mutating method names: a call through a typed attribute counts
+#: as a structural write for guard-discipline purposes
+_MUTATOR_METHS = {
+    "pop", "popitem", "append", "appendleft", "extend", "insert", "remove",
+    "clear", "update", "setdefault", "add", "discard",
+}
+
+
+class F1BlockingUnderLock(FlowRule):
+    """A lock-held region must stay O(microseconds): any operation that can
+    park the thread — untimed queue get/put, bare socket recv/send,
+    ``time.sleep``, untimed ``.wait()``, subprocess waits, device
+    transfers/syncs — wedges every other thread contending on that lock
+    (in this repo that is usually the health loop or the dispatch path).
+    The check is interprocedural: a call whose *callee* transitively blocks
+    is reported with the witness chain. The same rule owns lock *discipline*:
+    an attribute written under ``self._lock`` in one method and bare in
+    another is exactly the ``_try_admit`` decrement-race shape from PR 16,
+    so inconsistently-guarded writes are flagged too."""
+
+    id = "F1"
+    name = "blocking-under-lock"
+    summary = ("blocking op (or transitively blocking call) inside a "
+               "lock-held region; or a lock-guarded attribute written "
+               "without the lock")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        yield from self._blocking(ctx)
+        yield from self._guard_discipline(ctx)
+
+    def _blocking(self, ctx) -> Iterator[Finding]:
+        for fn in ctx.project.functions.values():
+            regions = ctx.regions(fn)
+            if not regions:
+                continue
+            locals_ = local_types(ctx.project, fn)
+            direct = {id(op.node): op
+                      for op in ctx.blocking.direct.get(fn.qualname, [])}
+            for region in regions:
+                seen_calls: Set[int] = set()
+                for node in nodes_under(region.node):
+                    op = direct.get(id(node))
+                    if op is not None:
+                        yield _finding(
+                            self, fn, node,
+                            f"{op.detail} while holding {region.lock_id} "
+                            f"in {_short(fn.qualname)}")
+                        continue
+                    if not isinstance(node, ast.Call) or id(node) in seen_calls:
+                        continue
+                    seen_calls.add(id(node))
+                    for tgt in resolve_call(ctx.project, fn, node, locals_,
+                                            duck=True):
+                        hit = ctx.blocking.may_block(tgt.qualname)
+                        if hit is None:
+                            continue
+                        chain, op = hit
+                        path = " -> ".join(_short(q) for q in chain)
+                        yield _finding(
+                            self, fn, node,
+                            f"call to {_short(tgt.qualname)} may block "
+                            f"({op.detail} via {path}) while holding "
+                            f"{region.lock_id}")
+                        break
+
+    def _guard_discipline(self, ctx) -> Iterator[Finding]:
+        callers = _reverse_edges(ctx)
+        # class qual -> attr -> (locked write sites, unlocked write sites)
+        writes: Dict[str, Dict[str, Tuple[list, list]]] = {}
+        for fn in ctx.project.functions.values():
+            if fn.name == "__init__":
+                continue
+            locals_ = local_types(ctx.project, fn)
+            regions = ctx.regions(fn)
+            fresh = _fresh_locals(fn)
+            for sub in ast.walk(fn.node):
+                if isinstance(sub, (ast.Assign, ast.AugAssign)):
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                elif isinstance(sub, ast.Delete):
+                    targets = sub.targets
+                elif isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr in _MUTATOR_METHS and \
+                        isinstance(sub.func.value, ast.Attribute):
+                    # self._table.pop(...) mutates _table just like
+                    # ``del self._table[k]`` — count it as a write
+                    targets = [sub.func.value]
+                else:
+                    continue
+                for t in targets:
+                    base = t
+                    if isinstance(base, ast.Subscript):
+                        base = base.value
+                    if not isinstance(base, ast.Attribute):
+                        continue
+                    recv = base.value
+                    if isinstance(recv, ast.Name) and recv.id in fresh:
+                        continue  # freshly constructed, not yet shared
+                    rc = receiver_class(ctx.project, fn, recv, locals_)
+                    if rc is None:
+                        continue
+                    lock = _holding_lock_of(ctx, rc, regions, sub) or \
+                        _always_called_under_lock(ctx, fn, rc, callers)
+                    slot = writes.setdefault(rc.qualname, {}).setdefault(
+                        base.attr, ([], []))
+                    (slot[0] if lock else slot[1]).append((fn, sub, base.attr))
+        for cq, attrs in sorted(writes.items()):
+            for attr, (locked, unlocked) in sorted(attrs.items()):
+                if not locked or not unlocked:
+                    continue
+                lfn = locked[0][0]
+                for fn, node, _ in unlocked:
+                    yield _finding(
+                        self, fn, node,
+                        f"{_short(cq)}.{attr} is written under the class "
+                        f"lock in {_short(lfn.qualname)} but without it "
+                        f"here — inconsistently guarded state")
+
+
+def _fresh_locals(fn: FunctionInfo) -> Set[str]:
+    """Names bound from a constructor call in this function: writes to their
+    attributes are pre-publication initialization, not shared-state races."""
+    out: Set[str] = set()
+    for sub in ast.walk(fn.node):
+        if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+            fname = dotted_name(sub.value.func)
+            last = (fname or "").split(".")[-1].lstrip("_")
+            if last[:1].isupper():
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _reverse_edges(ctx) -> Dict[str, List[Tuple[FunctionInfo, ast.AST]]]:
+    """callee qualname -> [(caller, call node)] over the whole graph."""
+    out: Dict[str, List[Tuple[FunctionInfo, ast.AST]]] = {}
+    for caller_q, callees in ctx.graph.edges.items():
+        caller = ctx.project.functions.get(caller_q)
+        if caller is None:
+            continue
+        for tgt, node in callees:
+            out.setdefault(tgt.qualname, []).append((caller, node))
+    return out
+
+
+def _always_called_under_lock(ctx, fn: FunctionInfo, rc: ClassInfo,
+                              callers) -> bool:
+    """A private helper whose EVERY resolvable call site sits inside a
+    with-region of a lock owned by ``rc`` effectively runs locked — its
+    writes are guarded even though it takes no lock itself (e.g. the
+    supervisor's ``_reap_retired``, called only from the locked tick)."""
+    incoming = callers.get(fn.qualname, [])
+    if not incoming or not fn.name.startswith("_"):
+        return False
+    mro_quals = {c.qualname for c in ctx.project.mro(rc)}
+    for caller, node in incoming:
+        under = False
+        for region in ctx.regions(caller):
+            if region.lock_id.rsplit(".", 1)[0] not in mro_quals:
+                continue
+            if any(n is node for n in nodes_under(region.node)):
+                under = True
+                break
+        if not under:
+            return False
+    return True
+
+
+def _holding_lock_of(ctx, rc: ClassInfo, regions, node: ast.AST
+                     ) -> Optional[str]:
+    """Is ``node`` inside a with-region of a lock OWNED by class ``rc``?"""
+    mro_quals = {c.qualname for c in ctx.project.mro(rc)}
+    for region in regions:
+        owner = region.lock_id.rsplit(".", 1)[0]
+        if owner not in mro_quals:
+            continue
+        for n in nodes_under(region.node):
+            if n is node:
+                return region.lock_id
+    return None
+
+
+# --------------------------------------------------------------------------
+# F2: lock-order inversion
+# --------------------------------------------------------------------------
+
+
+class F2LockOrderInversion(FlowRule):
+    """If one code path takes lock A then (directly or through calls) lock B
+    while another takes B then A, two threads can each hold one and wait
+    forever on the other. Edges are collected across the call graph:
+    ``with A: self.helper()`` contributes A→B when the helper acquires B.
+    Reported once per inverted pair with both witness sites."""
+
+    id = "F2"
+    name = "lock-order-inversion"
+    summary = "lock A held while acquiring B on one path, B-then-A on another"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        # acquired-locks closure per function
+        acquired: Dict[str, Set[str]] = {}
+        for fn in ctx.project.functions.values():
+            acquired[fn.qualname] = {r.lock_id for r in ctx.regions(fn)}
+        changed = True
+        passes = 0
+        while changed and passes < 32:
+            changed = False
+            passes += 1
+            for q, callees in ctx.graph.edges.items():
+                cur = acquired.setdefault(q, set())
+                before = len(cur)
+                for tgt, _n in callees:
+                    cur |= acquired.get(tgt.qualname, set())
+                if len(cur) != before:
+                    changed = True
+        # edges: (A, B) -> witness (fn, node)
+        edges: Dict[Tuple[str, str], Tuple[FunctionInfo, ast.AST]] = {}
+        for fn in ctx.project.functions.values():
+            regions = ctx.regions(fn)
+            if not regions:
+                continue
+            locals_ = local_types(ctx.project, fn)
+            for region in regions:
+                a = region.lock_id
+                for node in nodes_under(region.node):
+                    if isinstance(node, ast.With):
+                        inner = [r for r in ctx.regions(fn)
+                                 if r.node is node]
+                        for r in inner:
+                            if r.lock_id != a:
+                                edges.setdefault((a, r.lock_id), (fn, node))
+                    elif isinstance(node, ast.Call):
+                        for tgt in resolve_call(ctx.project, fn, node,
+                                                locals_):
+                            for b in acquired.get(tgt.qualname, set()):
+                                if b != a:
+                                    edges.setdefault((a, b), (fn, node))
+        reported: Set[Tuple[str, str]] = set()
+        for (a, b), (fn, node) in sorted(
+                edges.items(), key=lambda kv: (kv[1][0].path,
+                                               kv[1][1].lineno)):
+            if (b, a) not in edges or (b, a) in reported:
+                continue
+            reported.add((a, b))
+            ofn, onode = edges[(b, a)]
+            yield _finding(
+                self, fn, node,
+                f"lock order inversion: {a} -> {b} here, but {b} -> {a} in "
+                f"{_short(ofn.qualname)} ({ofn.path}:{onode.lineno})")
+
+
+# --------------------------------------------------------------------------
+# F3: thread loop with no reachable stop check
+# --------------------------------------------------------------------------
+
+_STOPPISH = {
+    "stopped", "stop", "stop_evt", "_stop_evt", "stop_event", "_stop_event",
+    "is_set", "closed", "_closed", "shutdown", "_shutdown", "running",
+    "_running", "exiting", "_exiting", "done", "_done", "stop_requested",
+}
+
+
+class F3UnstoppableLoop(FlowRule):
+    """Every thread body must be able to observe shutdown: a ``while True``
+    on a thread root with no ``break``/``return`` and no stop-flag check
+    (directly or in a callee within two hops) runs until process exit,
+    which turns clean shutdown into ``ensure_proc_terminate`` SIGKILLs and
+    leaks the thread past ``stop()``/``join()``."""
+
+    id = "F3"
+    name = "unstoppable-thread-loop"
+    summary = ("while-True on a thread root with no reachable "
+               "stop-flag/stop-event check and no break/return")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        seen_loops: Set[int] = set()
+        for root in ctx.roots:
+            reach = ctx.graph.reachable([root.fn.qualname], max_depth=8)
+            for qual in sorted(reach):
+                fn = ctx.project.functions.get(qual)
+                if fn is None:
+                    continue
+                for loop in _const_true_loops(fn.node):
+                    if id(loop) in seen_loops:
+                        continue
+                    seen_loops.add(id(loop))
+                    if self._can_stop(ctx, fn, loop, depth=2):
+                        continue
+                    yield _finding(
+                        self, fn, loop,
+                        f"while-True in {_short(fn.qualname)} (thread root "
+                        f"{_short(root.fn.qualname)}) has no reachable "
+                        f"stop check, break, or return")
+
+    def _can_stop(self, ctx, fn: FunctionInfo, loop: ast.While,
+                  depth: int) -> bool:
+        if _mentions_stoppish(loop.test):
+            return True
+        for stmt in loop.body:
+            for node in _walk_same_function(stmt):
+                if isinstance(node, ast.Return):
+                    return True
+                if isinstance(node, ast.Break) and \
+                        _owner_loop(node, stmt, loop) is loop:
+                    return True
+                if _mentions_stoppish(node):
+                    return True
+                if depth > 0 and isinstance(node, ast.Call):
+                    locals_ = local_types(ctx.project, fn)
+                    for tgt in resolve_call(ctx.project, fn, node, locals_):
+                        if self._callee_stops(ctx, tgt, depth - 1, set()):
+                            return True
+        return False
+
+    def _callee_stops(self, ctx, fn: FunctionInfo, depth: int,
+                      seen: Set[str]) -> bool:
+        if fn.qualname in seen:
+            return False
+        seen.add(fn.qualname)
+        for node in ast.walk(fn.node):
+            if _mentions_stoppish(node):
+                return True
+            if isinstance(node, ast.Raise):
+                return True  # raising unwinds out of the loop
+        if depth > 0:
+            for tgt, _n in ctx.graph.callees(fn.qualname):
+                if self._callee_stops(ctx, tgt, depth - 1, seen):
+                    return True
+        return False
+
+
+def _const_true_loops(fn_node: ast.AST) -> Iterator[ast.While]:
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.While) and isinstance(node.test,
+                                                      ast.Constant) \
+                and bool(node.test.value):
+            yield node
+
+
+def _mentions_stoppish(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _STOPPISH:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in _STOPPISH:
+            return True
+    return False
+
+
+def _walk_same_function(stmt: ast.AST) -> Iterator[ast.AST]:
+    """Walk without descending into nested function/class definitions.
+    When the root itself is a function def, its own body IS walked."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        stack = list(ast.iter_child_nodes(stmt))
+    else:
+        stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _owner_loop(brk: ast.AST, top_stmt: ast.AST,
+                outer: ast.While) -> Optional[ast.AST]:
+    """The loop a ``break`` belongs to, searching down from ``outer``."""
+    # parents were annotated at parse time by the project loader
+    cur = getattr(brk, "_ba3c_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.While)):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+        cur = getattr(cur, "_ba3c_parent", None)
+    return None
+
+
+# --------------------------------------------------------------------------
+# F4: join-on-self / join-under-lock
+# --------------------------------------------------------------------------
+
+
+class F4BadJoin(FlowRule):
+    """``self.join()`` reachable from a thread's own ``run()`` deadlocks the
+    thread on itself; ``.join()`` while holding a lock deadlocks if the
+    joined thread ever needs that lock to exit its loop (and stalls every
+    contender even when it doesn't). Joins belong after locks are released,
+    in the owner's ``stop()``/``close()`` epilogue."""
+
+    id = "F4"
+    name = "bad-join"
+    summary = "join-on-self from run(), or .join() inside a lock-held region"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        yield from self._join_on_self(ctx)
+        yield from self._join_under_lock(ctx)
+
+    def _join_on_self(self, ctx) -> Iterator[Finding]:
+        for ci in ctx.project.classes.values():
+            if not ctx.project.is_threadish(ci):
+                continue
+            run = ci.methods.get("run")
+            if run is None:
+                continue
+            reach = ctx.graph.reachable([run.qualname], max_depth=8)
+            for qual in sorted(reach):
+                fn = ctx.project.functions.get(qual)
+                if fn is None or fn.cls is None:
+                    continue
+                fci = ctx.project.class_of(fn)
+                if fci is None or ci.qualname not in {
+                        c.qualname for c in ctx.project.mro(fci)} and \
+                        fci.qualname not in {
+                            c.qualname for c in ctx.project.mro(ci)}:
+                    continue
+                for node in ast.walk(fn.node):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Attribute) and \
+                            node.func.attr == "join" and \
+                            isinstance(node.func.value, ast.Name) and \
+                            node.func.value.id == "self":
+                        yield _finding(
+                            self, fn, node,
+                            f"self.join() in {_short(fn.qualname)} is "
+                            f"reachable from {_short(run.qualname)} — a "
+                            f"thread joining itself deadlocks")
+
+    def _join_under_lock(self, ctx) -> Iterator[Finding]:
+        joins = _JoinClosure(ctx)
+        for fn in ctx.project.functions.values():
+            regions = ctx.regions(fn)
+            if not regions:
+                continue
+            locals_ = local_types(ctx.project, fn)
+            for region in regions:
+                for node in nodes_under(region.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if _is_untimed_join(node):
+                        yield _finding(
+                            self, fn, node,
+                            f".join() while holding {region.lock_id} in "
+                            f"{_short(fn.qualname)}")
+                        continue
+                    for tgt in resolve_call(ctx.project, fn, node, locals_,
+                                            duck=True):
+                        chain = joins.may_join(tgt.qualname)
+                        if chain:
+                            path = " -> ".join(_short(q) for q in chain)
+                            yield _finding(
+                                self, fn, node,
+                                f"call to {_short(tgt.qualname)} reaches a "
+                                f".join() ({path}) while holding "
+                                f"{region.lock_id}")
+                            break
+
+
+class _JoinClosure:
+    """qualname -> witness chain to a function containing an UNTIMED
+    .join() call. Timed joins (``join(timeout=...)`` / ``join(0)``) are
+    bounded reaps, not deadlock hazards; joins inside nested function defs
+    (e.g. atexit handlers registered by ensure_proc_terminate) do not run
+    at call time and are excluded."""
+
+    def __init__(self, ctx):
+        self.chains: Dict[str, List[str]] = {}
+        for fn in ctx.project.functions.values():
+            for node in _walk_same_function(fn.node):
+                if _is_untimed_join(node):
+                    self.chains[fn.qualname] = [fn.qualname]
+                    break
+        changed = True
+        while changed:
+            changed = False
+            for q, callees in ctx.graph.edges.items():
+                if q in self.chains:
+                    continue
+                for tgt, _n in callees:
+                    hit = self.chains.get(tgt.qualname)
+                    if hit is not None and q not in hit and len(hit) < 12:
+                        self.chains[q] = [q] + hit
+                        changed = True
+                        break
+
+    def may_join(self, qual: str) -> Optional[List[str]]:
+        return self.chains.get(qual)
+
+
+def _is_untimed_join(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+            and not node.args
+            and not any(kw.arg == "timeout" for kw in node.keywords))
+
+
+# --------------------------------------------------------------------------
+# F5: lifecycle leak
+# --------------------------------------------------------------------------
+
+_STOP_METHS = {"stop", "close", "shutdown", "terminate", "kill", "cancel"}
+
+
+class F5LifecycleLeak(FlowRule):
+    """Whoever starts a thread owns its join. A class (or function) that
+    constructs AND starts a thread-like object but never joins it leaks the
+    thread past shutdown: ``stop()`` returns while the loop is mid-tick,
+    state teardown races the still-running body, and process exit relies on
+    daemon reaping. Matching is token-based (the attribute/variable the
+    object is bound to), with ``for t in self.threads`` aliasing."""
+
+    id = "F5"
+    name = "lifecycle-leak"
+    summary = ("thread-like object constructed and started but never "
+               "joined (and/or never stopped) by its owner")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for ci in ctx.project.classes.values():
+            yield from self._check_scope(
+                ctx, list(ci.methods.values()), f"class {_short(ci.qualname)}")
+        for fn in ctx.project.functions.values():
+            if fn.cls is None:
+                yield from self._check_scope(
+                    ctx, [fn], f"function {_short(fn.qualname)}")
+
+    def _check_scope(self, ctx, fns: List[FunctionInfo],
+                     scope: str) -> Iterator[Finding]:
+        created: Dict[str, Tuple[FunctionInfo, ast.AST, str]] = {}
+        started: Set[str] = set()
+        joined: Set[str] = set()
+        stopped: Set[str] = set()
+        aliases: Dict[str, str] = {}
+
+        for fn in fns:
+            mod = ctx.project.module_of(fn)
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    ctor = dotted_name(node.value.func)
+                    if ctor and ctx.is_threadish_ctor(mod.resolve(ctor)):
+                        for t in node.targets:
+                            tok = _token_of(t)
+                            if tok:
+                                created.setdefault(
+                                    tok, (fn, node.value,
+                                          mod.resolve(ctor)))
+                elif isinstance(node, ast.For):
+                    tok = _token_of(node.target)
+                    src = _token_of(node.iter)
+                    if tok and src:
+                        aliases[tok] = src
+                elif isinstance(node, ast.Assign) and \
+                        isinstance(node.value, (ast.Name, ast.Attribute)):
+                    tok = None
+                    for t in node.targets:
+                        tok = tok or _token_of(t)
+                    src = _token_of(node.value)
+                    if tok and src and tok != src:
+                        aliases[src] = tok  # self.X = local: join via X counts
+                        aliases[tok] = src
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute):
+                    tok = _token_of(node.func.value)
+                    if tok is None:
+                        continue
+                    if node.func.attr == "start":
+                        started.add(tok)
+                    elif node.func.attr == "join":
+                        joined.add(tok)
+                    elif node.func.attr in _STOP_METHS:
+                        stopped.add(tok)
+
+        def expand(toks: Set[str]) -> Set[str]:
+            out = set(toks)
+            for t in toks:
+                a = aliases.get(t)
+                if a:
+                    out.add(a)
+            return out
+
+        started = expand(started)
+        joined = expand(joined)
+        stopped = expand(stopped)
+        for tok, (fn, site, ctor) in sorted(created.items()):
+            if tok not in started:
+                continue  # constructed here, started/owned elsewhere
+            if tok in joined:
+                continue
+            if tok in stopped:
+                yield _finding(
+                    self, fn, site,
+                    f"{scope} starts {ctor.split('.')[-1]} ({tok!r}) and "
+                    f"stops it but never joins it — shutdown returns while "
+                    f"the thread is still running")
+            else:
+                yield _finding(
+                    self, fn, site,
+                    f"{scope} starts {ctor.split('.')[-1]} ({tok!r}) but "
+                    f"never stops or joins it")
+
+
+def _token_of(expr: ast.AST) -> Optional[str]:
+    """The identifying token of a receiver/target: the last attribute name
+    of a self-chain, or a bare variable name."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, (ast.Tuple, ast.List)) and expr.elts:
+        return _token_of(expr.elts[0])
+    if isinstance(expr, ast.Call):
+        return _token_of(expr.func) if isinstance(expr.func, ast.Attribute) \
+            and expr.func.attr in ("values", "items", "keys") and \
+            isinstance(expr.func.value, (ast.Name, ast.Attribute)) \
+            else None
+    return None
+
+
+# --------------------------------------------------------------------------
+# F6: project-API conformance
+# --------------------------------------------------------------------------
+
+#: attributes provided by external bases we model (threading/multiprocessing)
+_EXTERNAL_ATTRS = {
+    "threading.Thread": {
+        "start", "join", "run", "is_alive", "daemon", "name", "ident",
+        "native_id", "isDaemon", "setDaemon", "getName", "setName",
+    },
+    "multiprocessing.Process": {
+        "start", "join", "run", "is_alive", "daemon", "name", "pid",
+        "exitcode", "terminate", "kill", "close", "sentinel", "authkey",
+    },
+}
+
+_OBJECT_ATTRS = {
+    "__init__", "__class__", "__dict__", "__repr__", "__str__", "__eq__",
+    "__hash__", "__reduce__", "__sizeof__", "__format__", "__dir__",
+}
+
+
+class F6ApiConformance(FlowRule):
+    """A call against a project module or project-typed object must resolve
+    statically: ``logger.exception(...)`` against a logger module that never
+    defined ``exception`` raised AttributeError *inside the tick guard it
+    was protecting* and sat latent from PR 7 to PR 16. Modules with
+    ``__getattr__`` and classes with dynamic attribute machinery are
+    exempt; classes with unmodeled external bases are only checked against
+    the attribute tables we have."""
+
+    id = "F6"
+    name = "api-conformance"
+    summary = ("attribute call on a project module/object that does not "
+               "exist statically")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        self._absorb_external_writes(ctx)
+        for fn in ctx.project.functions.values():
+            mod = ctx.project.module_of(fn)
+            locals_ = local_types(ctx.project, fn)
+            for node in ast.walk(fn.node):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                attr = node.func.attr
+                base = node.func.value
+                # module attribute call
+                base_dotted = dotted_name(base)
+                if base_dotted and "." not in base_dotted or isinstance(
+                        base, ast.Attribute):
+                    canon = mod.resolve(base_dotted) if base_dotted else None
+                    m = ctx.project.find_module(canon) if canon else None
+                    if m is not None:
+                        if not m.has_module_getattr and \
+                                attr not in m.toplevel:
+                            yield _finding(
+                                self, fn, node,
+                                f"module {m.modname} has no attribute "
+                                f"{attr!r} (called from "
+                                f"{_short(fn.qualname)})")
+                        continue
+                # typed-object method call (self.x() / task.x())
+                if isinstance(base, ast.Name):
+                    rc = receiver_class(ctx.project, fn, base, locals_)
+                    if rc is None:
+                        continue
+                    if self._class_has(ctx, rc, attr):
+                        continue
+                    yield _finding(
+                        self, fn, node,
+                        f"{_short(rc.qualname)} has no attribute {attr!r} "
+                        f"(called from {_short(fn.qualname)})")
+
+    def _class_has(self, ctx, rc: ClassInfo, attr: str) -> bool:
+        if attr in _OBJECT_ATTRS or (attr.startswith("__")
+                                     and attr.endswith("__")):
+            return True
+        for c in ctx.project.mro(rc):
+            if c.dynamic_attrs or attr in c.attrs or attr in c.methods:
+                return True
+        ext = ctx.project.external_bases(rc)
+        for b in ext:
+            allowed = _EXTERNAL_ATTRS.get(b)
+            if allowed is None:
+                return True  # unmodeled base: stand down
+            if attr in allowed:
+                return True
+        return False
+
+    def _absorb_external_writes(self, ctx) -> None:
+        """``obj.attr = x`` on a typed receiver anywhere in the project makes
+        ``attr`` a real attribute of that class (external wiring like
+        ``router.latency_tap = tap`` must not read as nonexistence)."""
+        if getattr(ctx, "_f6_absorbed", False):
+            return
+        ctx._f6_absorbed = True
+        for fn in ctx.project.functions.values():
+            locals_ = local_types(ctx.project, fn)
+            for node in ast.walk(fn.node):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Attribute):
+                        rc = receiver_class(ctx.project, fn, t.value, locals_)
+                        if rc is not None:
+                            rc.attrs.add(t.attr)
+
+
+def all_flow_rules() -> List[FlowRule]:
+    return [
+        F1BlockingUnderLock(),
+        F2LockOrderInversion(),
+        F3UnstoppableLoop(),
+        F4BadJoin(),
+        F5LifecycleLeak(),
+        F6ApiConformance(),
+    ]

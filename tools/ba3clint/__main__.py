@@ -12,6 +12,7 @@ import sys
 from typing import List, Optional
 
 from tools.ba3clint import all_rules, lint_paths
+from tools.ba3clint.engine import check_suppressions
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -41,6 +42,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         action="store_true",
         help="print the rule catalog and exit",
     )
+    parser.add_argument(
+        "--sarif",
+        metavar="FILE",
+        help="also write findings as SARIF 2.1.0 to FILE",
+    )
+    parser.add_argument(
+        "--check-suppressions",
+        action="store_true",
+        help="flag '# ba3clint: disable=' comments that mask no finding",
+    )
     args = parser.parse_args(argv)
 
     rules = all_rules()
@@ -60,10 +71,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         rules = [r for r in rules if r.id in wanted]
 
     try:
-        findings = lint_paths(args.paths, rules)
+        if args.check_suppressions:
+            findings = check_suppressions(args.paths, rules)
+        else:
+            findings = lint_paths(args.paths, rules)
     except FileNotFoundError as e:
         print(f"ba3clint: {e}", file=sys.stderr)
         return 2
+    if args.sarif:
+        from tools.sarif import write_sarif
+        write_sarif(args.sarif, findings, "ba3clint", rules)
     if args.format == "json":
         print(json.dumps([f.to_dict() for f in findings], indent=2))
     else:

@@ -16,8 +16,10 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import os
 import re
+import tokenize
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
 
@@ -48,19 +50,30 @@ class Rule:
 # suppression comments
 # --------------------------------------------------------------------------
 
-_SUPPRESS_RE = re.compile(r"#\s*ba3clint:\s*disable=([A-Za-z0-9_*,\s-]+)")
+_SUPPRESS_RE_CACHE: Dict[str, "re.Pattern[str]"] = {}
 
 
-def suppressions(source: str) -> Dict[int, Set[str]]:
+def _suppress_re(tool: str) -> "re.Pattern[str]":
+    pat = _SUPPRESS_RE_CACHE.get(tool)
+    if pat is None:
+        pat = re.compile(
+            r"#\s*" + re.escape(tool) + r":\s*disable=([A-Za-z0-9_*,\s-]+)")
+        _SUPPRESS_RE_CACHE[tool] = pat
+    return pat
+
+
+def suppressions(source: str, tool: str = "ba3clint") -> Dict[int, Set[str]]:
     """Map line number -> suppressed rule ids (``ALL`` disables every rule).
 
     A trailing comment suppresses its own line; a standalone comment line
     suppresses the following line as well (for statements too long to carry
-    the comment inline).
+    the comment inline). ``tool`` selects the comment spelling — ba3cflow
+    reuses this parser with ``tool="ba3cflow"``.
     """
+    pat = _suppress_re(tool)
     out: Dict[int, Set[str]] = {}
-    for i, line in enumerate(source.splitlines(), start=1):
-        m = _SUPPRESS_RE.search(line)
+    for i, text, standalone in _comment_tokens(source):
+        m = pat.search(text)
         if not m:
             continue
         rules = {
@@ -69,8 +82,67 @@ def suppressions(source: str) -> Dict[int, Set[str]]:
             if r.strip()
         }
         out.setdefault(i, set()).update(rules)
-        if line.lstrip().startswith("#"):
+        if standalone:
             out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+def _comment_tokens(source: str) -> Iterator[Tuple[int, str, bool]]:
+    """(line, comment text, is-standalone) for each REAL comment.
+
+    Tokenizing (rather than regex over raw lines) keeps ``disable=`` text
+    inside string literals — docstrings documenting the suppression syntax —
+    from acting as, or being audited as, a live suppression.
+    """
+    try:
+        toks = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        # unparseable tail: fall back to the raw-line scan so a suppression
+        # above the damage still works
+        for i, line in enumerate(source.splitlines(), start=1):
+            if "#" in line:
+                yield i, line[line.index("#"):], line.lstrip().startswith("#")
+        return
+    for tok in toks:
+        if tok.type == tokenize.COMMENT:
+            yield tok.start[0], tok.string, tok.line.lstrip().startswith("#")
+
+
+def stale_suppressions(source: str, path: str, raw: Sequence[Finding],
+                       tool: str) -> List[Finding]:
+    """Suppression comments in ``source`` that no longer mask any finding.
+
+    ``raw`` must be the UNSUPPRESSED findings for this file. Each rule id in
+    a ``disable=`` list is checked independently: disabling A6,A12 when only
+    A6 still fires reports A12 as stale. Stale suppressions are findings in
+    their own right (rule ``S001``) — a dead suppression is a claim about an
+    invariant the code no longer exercises, which misleads the next reader.
+    """
+    pat = _suppress_re(tool)
+    by_line: Dict[int, Set[str]] = {}
+    for f in raw:
+        by_line.setdefault(f.line, set()).add(f.rule.upper())
+    out: List[Finding] = []
+    for i, text, standalone in _comment_tokens(source):
+        m = pat.search(text)
+        if not m:
+            continue
+        covered = {i}
+        if standalone:
+            covered.add(i + 1)
+        fired: Set[str] = set()
+        for ln in covered:
+            fired |= by_line.get(ln, set())
+        rules = [r.strip().upper()
+                 for r in m.group(1).replace(";", ",").split(",")
+                 if r.strip()]
+        for rid in rules:
+            used = bool(fired) if rid == "ALL" else rid in fired
+            if not used:
+                out.append(Finding(
+                    path, i, 0, "S001",
+                    f"stale suppression: {tool}: disable={rid} masks no "
+                    f"finding on this line"))
     return out
 
 
@@ -306,7 +378,8 @@ def iter_py_files(paths: Sequence[str]) -> Iterator[str]:
                     yield os.path.join(root, f)
 
 
-def lint_file(path: str, rules: Iterable[Rule]) -> List[Finding]:
+def lint_file(path: str, rules: Iterable[Rule],
+              apply_suppressions: bool = True) -> List[Finding]:
     with open(path, "r", encoding="utf-8") as fh:
         source = fh.read()
     try:
@@ -317,7 +390,7 @@ def lint_file(path: str, rules: Iterable[Rule]) -> List[Finding]:
                     f"syntax error: {e.msg}")
         ]
     ctx = FileContext(path, source, tree, ModuleInfo(tree))
-    sup = suppressions(source)
+    sup = suppressions(source) if apply_suppressions else {}
     out: List[Finding] = []
     for rule in rules:
         for f in rule.check(ctx):
@@ -325,6 +398,20 @@ def lint_file(path: str, rules: Iterable[Rule]) -> List[Finding]:
             if "ALL" in disabled or f.rule.upper() in disabled:
                 continue
             out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def check_suppressions(paths: Sequence[str],
+                       rules: Iterable[Rule]) -> List[Finding]:
+    """Stale ``# ba3clint: disable=`` comments across ``paths`` (rule S001)."""
+    rules = list(rules)
+    out: List[Finding] = []
+    for path in iter_py_files(paths):
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        raw = lint_file(path, rules, apply_suppressions=False)
+        out.extend(stale_suppressions(source, path, raw, "ba3clint"))
     out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return out
 

@@ -1,0 +1,84 @@
+"""Shared SARIF 2.1.0 export for ba3clint and ba3cflow.
+
+One run per invocation, one result per finding. The output is the minimal
+schema-valid document github/codeql-action/upload-sarif accepts, so CI can
+surface findings as PR annotations without any extra mapping layer. Paths
+are emitted repo-relative with ``%SRCROOT%`` as the base id — that is what
+the upload action expects when it runs from the checkout root.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional, Sequence
+
+_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+#: rules that indicate the analysis itself is degraded, not the code
+_ERROR_RULES = {"E001"}
+
+
+def to_sarif(findings: Sequence, tool_name: str, rules: Iterable,
+             info_uri: str = "docs/static_analysis.md") -> dict:
+    """Build a SARIF dict from :class:`~tools.ba3clint.engine.Finding`s.
+
+    ``rules`` is the rule catalog (objects with ``id``/``name``/``summary``);
+    rule metadata is emitted even for rules with no findings so the viewer
+    can render the full catalog.
+    """
+    rule_entries: List[dict] = []
+    rule_index = {}
+    for r in rules:
+        rule_index[r.id] = len(rule_entries)
+        rule_entries.append({
+            "id": r.id,
+            "name": r.name or r.id,
+            "shortDescription": {"text": r.summary or r.id},
+            "helpUri": info_uri,
+        })
+    results: List[dict] = []
+    for f in findings:
+        entry = {
+            "ruleId": f.rule,
+            "level": "error" if f.rule in _ERROR_RULES else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace("\\", "/"),
+                        "uriBaseId": "%SRCROOT%",
+                    },
+                    "region": {
+                        "startLine": max(1, f.line),
+                        "startColumn": max(1, f.col + 1),
+                    },
+                },
+            }],
+        }
+        idx = rule_index.get(f.rule)
+        if idx is not None:
+            entry["ruleIndex"] = idx
+        results.append(entry)
+    return {
+        "$schema": _SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": tool_name,
+                    "informationUri": info_uri,
+                    "rules": rule_entries,
+                },
+            },
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path: str, findings: Sequence, tool_name: str,
+                rules: Iterable,
+                info_uri: str = "docs/static_analysis.md") -> None:
+    doc = to_sarif(findings, tool_name, rules, info_uri)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
