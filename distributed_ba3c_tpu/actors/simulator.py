@@ -271,6 +271,21 @@ def default_pipes(name: str = "ba3c") -> tuple[str, str]:
 _spawn_ctx = mp.get_context("spawn")
 
 
+def _decode_action(raw: bytes, fallback, counter):
+    """Decode an action reply; junk must not kill the lockstep loop.
+
+    A corrupt reply frame is the master's bug (or the network's), not a
+    reason to lose this simulator's episode state (PR 14 class): repeat
+    the previous action, make the drop visible on the
+    ``corrupt_action_replies_total`` counter, and keep stepping.
+    """
+    try:
+        return loads(raw)
+    except Exception:
+        counter.inc()
+        return fallback
+
+
 class SimulatorProcess(_spawn_ctx.Process):  # type: ignore[name-defined]
     """One OS process owning one player; loop: send state, await action, step.
 
@@ -317,10 +332,12 @@ class SimulatorProcess(_spawn_ctx.Process):  # type: ignore[name-defined]
         c_eps = tele.counter("episodes_total")
         c_rew_pos = tele.counter("reward_pos_sum")
         c_rew_neg = tele.counter("reward_neg_sum")
+        c_bad = tele.counter("corrupt_action_replies_total")
         tracker = telemetry.DeltaTracker(tele)
 
         state = player.current_state()
         reward, is_over = 0.0, False
+        action = 0  # repeated on a corrupt reply (see _decode_action)
         step = 0
         env_us = 0  # last env-step duration, shipped in the trace context
         try:
@@ -336,7 +353,7 @@ class SimulatorProcess(_spawn_ctx.Process):  # type: ignore[name-defined]
                 # context 6th (THE one layout implementation — tracing.py)
                 tracing.stamp_wire_meta(msg, ident, step, d, env_us)
                 c2s.send(dumps(msg))
-                action = loads(s2c.recv())
+                action = _decode_action(s2c.recv(), action, c_bad)
                 t_env = tracing.now_us() if tracing.enabled() else 0
                 reward, is_over = player.action(action)
                 c_steps.inc()

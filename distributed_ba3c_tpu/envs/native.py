@@ -174,6 +174,36 @@ def build_cpp_player(idx: int, name: str = "pong", frame_history: int = 4):
     return HistoryFramePlayer(_CppPlayer(), frame_history)
 
 
+def _decode_actions(raw: bytes, fallback: np.ndarray, counter) -> np.ndarray:
+    """Decode a batched action-reply frame; junk must not kill the loop.
+
+    The env server's lockstep loop is supervisor-owned: a corrupt or
+    short reply frame (PR 14 class) repeats the previous actions, makes
+    the drop visible on ``corrupt_action_replies_total``, and keeps the
+    loop alive instead of raising out of ``_run_block*``.
+    """
+    try:
+        actions = np.frombuffer(raw, np.int32)
+    except Exception:
+        counter.inc()
+        return fallback
+    if actions.shape != fallback.shape:
+        counter.inc()
+        return fallback
+    return actions
+
+
+def _decode_action(raw: bytes, fallback: int, counter) -> int:
+    """Per-env twin of :func:`_decode_actions` for the ``per-env`` wire."""
+    from distributed_ba3c_tpu.utils.serialize import loads
+
+    try:
+        return int(loads(raw))
+    except Exception:
+        counter.inc()
+        return fallback
+
+
 class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc]
     """One process, B native envs, lockstep-batched stepping, ZMQ transport.
 
@@ -250,7 +280,9 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
     def _tele_setup(self):
         """Child-side telemetry: counters + the piggyback delta tracker.
 
-        Returns ``(count_step, piggyback, extend_meta)``: ``count_step``
+        Returns ``(count_step, piggyback, extend_meta, c_bad)``:
+        ``c_bad`` is the ``corrupt_action_replies_total`` reject counter
+        fed to the ``_decode_action*`` helpers; ``count_step``
         is called once per lockstep block step; ``piggyback(step)``
         returns the deltas dict to append to the wire header (or None —
         which keeps the header at its OLD length, so telemetry-disabled
@@ -271,6 +303,7 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
         # counter semantics; net reward = pos - neg at query time.
         c_rew_pos = tele.counter("reward_pos_sum")
         c_rew_neg = tele.counter("reward_neg_sum")
+        c_bad = tele.counter("corrupt_action_replies_total")
         tracker = telemetry.DeltaTracker(tele)
         B = self.n_envs
 
@@ -302,7 +335,7 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
                 meta, ident, step, piggyback(step), env_us
             )
 
-        return count_step, piggyback, extend_meta
+        return count_step, piggyback, extend_meta, c_bad
 
     def _run_block_shm(self) -> None:
         import signal
@@ -338,6 +371,7 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
         ring = ShmRing.create(ring_name, cap, B, H, W)
         rewards = np.zeros(B, np.float32)
         dones = np.zeros(B, np.uint8)
+        actions = np.zeros(B, np.int32)  # fallback on a corrupt reply
 
         ctx = zmq.Context()
         push = ctx.socket(zmq.PUSH)
@@ -347,7 +381,7 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
         dealer.setsockopt(zmq.IDENTITY, ident)
         dealer.connect(self.s2c)
 
-        count_step, piggyback, extend_meta = self._tele_setup()
+        count_step, piggyback, extend_meta, c_bad = self._tele_setup()
         from distributed_ba3c_tpu.telemetry import tracing
 
         step = 0
@@ -368,7 +402,7 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
                     pack_block(meta, [rewards, dones]),
                     copy=False,
                 )
-                actions = np.frombuffer(dealer.recv(), np.int32)  # ba3clint: disable=A12 — lockstep park
+                actions = _decode_actions(dealer.recv(), actions, c_bad)  # ba3clint: disable=A12 — lockstep park
                 t_env = tracing.now_us() if tracing.enabled() else 0
                 obs, rew, dn = env.step(actions)
                 if t_env:
@@ -399,6 +433,7 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
         stacks[-1] = obs
         rewards = np.zeros(B, np.float32)
         dones = np.zeros(B, np.uint8)
+        actions = np.zeros(B, np.int32)  # fallback on a corrupt reply
         ident = f"{self.ident_prefix}*block".encode()
 
         ctx = zmq.Context()
@@ -409,7 +444,7 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
         dealer.setsockopt(zmq.IDENTITY, ident)
         dealer.connect(self.s2c)
 
-        count_step, piggyback, extend_meta = self._tele_setup()
+        count_step, piggyback, extend_meta, c_bad = self._tele_setup()
         from distributed_ba3c_tpu.telemetry import tracing
 
         step = 0
@@ -427,7 +462,7 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
                     pack_block(meta, [stacks, rewards, dones]),
                     copy=False,
                 )
-                actions = np.frombuffer(dealer.recv(), np.int32)  # ba3clint: disable=A12 — lockstep park
+                actions = _decode_actions(dealer.recv(), actions, c_bad)  # ba3clint: disable=A12 — lockstep park
                 t_env = tracing.now_us() if tracing.enabled() else 0
                 obs, rew, dn = env.step(actions)
                 if t_env:
@@ -453,7 +488,7 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
     def _run_per_env(self) -> None:
         import zmq
 
-        from distributed_ba3c_tpu.utils.serialize import dumps, loads
+        from distributed_ba3c_tpu.utils.serialize import dumps
 
         env = CppBatchedEnv(self.game, self.n_envs, seed=self.idx * 10_000)
         obs = env.reset()
@@ -475,7 +510,7 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
             s.connect(self.s2c)
             dealers.append(s)
 
-        count_step, piggyback, _ = self._tele_setup()
+        count_step, piggyback, _, c_bad = self._tele_setup()
         actions = np.zeros(B, np.int32)
         step = 0
         try:
@@ -493,7 +528,11 @@ class CppEnvServerProcess(mp.get_context("spawn").Process):  # type: ignore[misc
                         dumps(msg)
                     )
                 for i in range(B):
-                    actions[i] = loads(dealers[i].recv())  # ba3clint: disable=A6,A12 — compat foil (lockstep park)
+                    actions[i] = _decode_action(
+                        dealers[i].recv(),  # ba3clint: disable=A6,A12 — compat foil (lockstep park)
+                        int(actions[i]),
+                        c_bad,
+                    )
                 obs, rew, dn = env.step(actions)
                 rewards[:] = rew
                 dones[:] = dn.astype(bool)

@@ -67,6 +67,7 @@ def _sniff_ident(frames: List[bytes]) -> Optional[bytes]:
         if isinstance(ident, (bytes, bytearray, memoryview)):
             return bytes(ident)
         return str(ident).encode()
+    # ba3cwire: disable=W4 — the sniffer classifies, never drops: an undecodable message still flows through the pump unfiltered, so there is no reject to count
     except Exception:
         return None
 

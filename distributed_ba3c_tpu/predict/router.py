@@ -314,8 +314,10 @@ class ServingRouter:
         import weakref
 
         ref = weakref.ref(self)
+        # "registered", not "*_total": the value is a level (it falls on
+        # retire), and gauges must not wear the monotonic-counter suffix
         tele.gauge(
-            "replicas_total",
+            "replicas_registered",
             fn=lambda: len(r._replicas) if (r := ref()) else 0,
         )
         tele.gauge(
@@ -983,7 +985,7 @@ class ServingRouter:
                     self._agg_last[rid] = entry
             self._agg = {
                 "replicas_live": float(len(live)),
-                "replicas_total": float(len(reps)),
+                "replicas_registered": float(len(reps)),
                 "served_p99_ms": p99 * 1000.0 if p99 is not None else None,
                 "shed_rate": (d_sheds / total) if total > 0 else 0.0,
                 "outstanding_rows": float(

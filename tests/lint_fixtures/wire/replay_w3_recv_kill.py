@@ -1,0 +1,20 @@
+"""Historical replay: the PR 14 receive-loop kill.
+
+Before PR 14 the master's experience pump decoded straight off the
+socket inside its poller loop — one corrupt frame from ONE env server
+raised out of the loop and silently starved EVERY peer (the fleet looked
+alive; throughput went to zero). W3 must flag the bare decode."""
+
+import zmq
+
+from distributed_ba3c_tpu.utils.serialize import loads
+
+
+def master_pump(sock, handle):
+    poller = zmq.Poller()
+    poller.register(sock, zmq.POLLIN)
+    while True:
+        if not poller.poll(100):
+            continue
+        frames = sock.recv_multipart()
+        handle(loads(frames[0]))

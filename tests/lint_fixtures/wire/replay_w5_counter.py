@@ -1,0 +1,15 @@
+"""Historical replay: the PR 5 sign-mixed reward counter.
+
+Before PR 5 raw Atari rewards (Pong: −1) were accumulated into ONE
+counter-typed series; the decreasing value read as a counter reset to
+Prometheus ``rate()``. The fix split the series by sign — W5 must flag
+the unguarded negated increment that recreates the bug."""
+
+from distributed_ba3c_tpu import telemetry
+
+tele = telemetry.registry("simulator")
+c_rew = tele.counter("reward_pos_sum")
+
+
+def account(reward):
+    c_rew.inc(-reward)

@@ -1,0 +1,5 @@
+"""A suppression that masks nothing — --check-suppressions must flag it."""
+
+
+def harmless(meta):
+    return meta[0]  # ba3cwire: disable=W2 — stale: nothing optional is read here

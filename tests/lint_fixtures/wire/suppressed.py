@@ -1,0 +1,13 @@
+"""Real W6 findings masked by a trailing and a standalone suppression —
+the filtered run must be clean, the raw run must see both."""
+
+import msgpack
+
+
+def ship_trailing(sock, obj):
+    sock.send(msgpack.packb(obj))  # ba3cwire: disable=W6 — fixture: trailing form
+
+
+def ship_standalone(sock, obj):
+    # ba3cwire: disable=W6 — fixture: standalone form covers next line
+    sock.send(msgpack.packb(obj))

@@ -1,0 +1,11 @@
+"""W1 must stay quiet: both halves pair up and frame counts agree."""
+
+from distributed_ba3c_tpu.utils.serialize import dumps
+
+
+def pack_pair(header, payload):
+    return [dumps(header), payload]
+
+
+def unpack_pair(frames):
+    return frames[0], frames[1]

@@ -1,0 +1,16 @@
+"""W1 must fire twice: an orphan packer, and a pack/unpack pair whose
+frame counts disagree (the unpacker indexes past what the packer emits)."""
+
+from distributed_ba3c_tpu.utils.serialize import dumps
+
+
+def pack_orphan(meta):
+    return [dumps(meta)]
+
+
+def pack_pair(header, payload):
+    return [dumps(header), payload]
+
+
+def unpack_pair(frames):
+    return frames[0], frames[1], frames[2]

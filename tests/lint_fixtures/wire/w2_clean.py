@@ -1,0 +1,12 @@
+"""W2 must stay quiet: the optional tail read is length-guarded, so old
+senders' shorter frames keep parsing (append-only, positions pinned)."""
+
+from distributed_ba3c_tpu.utils import serialize  # noqa: F401  wire-scope marker
+
+
+def header_tail(meta):
+    if len(meta) < 3:
+        raise ValueError("short header")
+    ident, step, b = meta[0], meta[1], meta[2]
+    tele = meta[3] if len(meta) > 3 else None
+    return ident, step, b, tele

@@ -1,0 +1,12 @@
+"""W2 must fire: a read past the validated base length with no covering
+length guard — old senders' shorter frames would IndexError here."""
+
+from distributed_ba3c_tpu.utils import serialize  # noqa: F401  wire-scope marker
+
+
+def header_tail(meta):
+    if len(meta) < 3:
+        raise ValueError("short header")
+    ident, step, b = meta[0], meta[1], meta[2]
+    tele = meta[3]
+    return ident, step, b, tele

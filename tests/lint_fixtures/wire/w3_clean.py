@@ -1,0 +1,29 @@
+"""W3 must stay quiet: one loop wraps the decode so a corrupt frame
+continues it, the other contains the decode (and its accounting) in the
+callee — both count the reject, so W4 stays quiet too."""
+
+from distributed_ba3c_tpu.utils.serialize import loads
+
+
+def _decode_safe(raw, counter):
+    try:
+        return loads(raw)
+    except ValueError:
+        counter.inc()
+        return None
+
+
+def pump_wrapped(sock, out, counter):
+    while True:
+        raw = sock.recv()
+        try:
+            msg = loads(raw)
+        except ValueError:
+            counter.inc()
+            continue
+        out.append(msg)
+
+
+def pump_contained(sock, out, counter):
+    while True:
+        out.append(_decode_safe(sock.recv(), counter))

@@ -1,0 +1,18 @@
+"""W3 must fire twice: a bare decode straight off the socket, and a call
+into a helper whose decode can raise back into the receive loop."""
+
+from distributed_ba3c_tpu.utils.serialize import loads
+
+
+def _decode(raw):
+    return loads(raw)
+
+
+def pump_bare(sock, out):
+    while True:
+        out.append(loads(sock.recv()))
+
+
+def pump_chained(sock, out):
+    while True:
+        out.append(_decode(sock.recv()))

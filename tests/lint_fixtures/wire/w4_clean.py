@@ -1,0 +1,26 @@
+"""W4 must stay quiet: the reject is counted — once directly in the
+handler, once through a callee (the interprocedural witness)."""
+
+from distributed_ba3c_tpu.utils.serialize import loads
+
+
+def _count_reject(counter):
+    counter.inc()
+
+
+def handle_direct(sock, counter):
+    raw = sock.recv()
+    try:
+        return loads(raw)
+    except ValueError:
+        counter.inc()
+        return None
+
+
+def handle_via_callee(sock, counter):
+    raw = sock.recv()
+    try:
+        return loads(raw)
+    except ValueError:
+        _count_reject(counter)
+        return None

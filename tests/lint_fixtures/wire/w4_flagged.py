@@ -1,0 +1,12 @@
+"""W4 must fire: the decode-failure handler discards the message without
+incrementing any reject counter — drops vanish from /metrics."""
+
+from distributed_ba3c_tpu.utils.serialize import loads
+
+
+def handle_once(sock):
+    raw = sock.recv()
+    try:
+        return loads(raw)
+    except ValueError:
+        return None

@@ -1,0 +1,15 @@
+"""W5 must fire five times: a gauge wearing the ``*_total`` counter
+suffix (also undocumented), ``set()`` on a counter, a negative-literal
+``inc``, and a negated ``inc`` with no dominating sign guard."""
+
+from distributed_ba3c_tpu import telemetry
+
+tele = telemetry.registry("fixture")
+g_bad = tele.gauge("wire_fixture_widgets_total")
+c_steps = tele.counter("env_steps_total")
+
+
+def account(delta):
+    c_steps.set(0)
+    c_steps.inc(-5)
+    c_steps.inc(-delta)

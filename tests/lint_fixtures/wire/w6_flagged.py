@@ -1,0 +1,14 @@
+"""W6 must fire twice: raw msgpack outside the codec layer, and an
+explicit ``crc=False`` opt-out at a non-codec call site."""
+
+import msgpack
+
+from distributed_ba3c_tpu.utils.serialize import dumps
+
+
+def ship_raw(sock, obj):
+    sock.send(msgpack.packb(obj))
+
+
+def ship_uncovered(sock, obj):
+    sock.send(dumps(obj, crc=False))

@@ -1,0 +1,277 @@
+"""tools/ba3cwire: per-rule fixtures, historical replays, CLI contract.
+
+Mirrors the ba3clint/ba3cflow test structure: every wire rule must (a)
+fire on its ``w*_flagged.py`` fixture and (b) stay quiet on its
+``w*_clean.py`` fixture — the clean fixtures encode the wire idioms the
+real codebase uses (paired codecs with agreeing frame counts,
+length-guarded optional header reads, wrapped receive-loop decodes with
+counted rejects, sign-split counters), so a rule regression that would
+spam the repo fails here first. The replay fixtures pin the analyzer to
+two bugs that actually shipped in this repo: PR 14's receive-loop kill
+(one corrupt frame starved every peer) and PR 5's sign-mixed reward
+counter (decreasing counters read as Prometheus resets). The CLI tests
+pin the exit-status contract CI gates on, and the SARIF test pins the
+schema the upload step consumes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.analyzer_core import stale_suppressions, suppressions
+from tools.ba3cwire import all_rules
+from tools.ba3cwire.engine import build_context, filter_suppressed, run_rules
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures", "wire")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RULE_IDS = ["W1", "W2", "W3", "W4", "W5", "W6"]
+
+
+def _analyze(*names, suppress=True):
+    paths = [os.path.join(FIXTURES, n) for n in names]
+    ctx = build_context(paths, root=REPO_ROOT)
+    raw = run_rules(ctx, all_rules())
+    return (filter_suppressed(ctx, raw) if suppress else raw), ctx
+
+
+def _findings(name, rule_id=None, suppress=True):
+    out, _ = _analyze(name, suppress=suppress)
+    if rule_id is not None:
+        out = [f for f in out if f.rule == rule_id]
+    return out
+
+
+def _cli(*args, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.ba3cwire", *args],
+        cwd=cwd, capture_output=True, text=True,
+    )
+
+
+def _fx(name):
+    return os.path.join("tests", "lint_fixtures", "wire", name)
+
+
+# -- rule registry ----------------------------------------------------------
+
+
+def test_rule_registry_complete():
+    assert [r.id for r in all_rules()] == RULE_IDS
+    for r in all_rules():
+        assert r.id and r.name and r.summary and r.__doc__
+
+
+# -- fixture pairs ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_flagged_fixture_fires(rule_id):
+    name = f"{rule_id.lower()}_flagged.py"
+    hits = _findings(name, rule_id)
+    assert hits, f"{rule_id} produced no findings on {name}"
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_flagged_fixture_fires_only_its_own_rule(rule_id):
+    """Cross-rule noise on a flagged fixture means a rule is over-broad."""
+    name = f"{rule_id.lower()}_flagged.py"
+    other = [f for f in _findings(name) if f.rule != rule_id]
+    assert not other, other
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_clean_fixture_clean_under_every_rule(rule_id):
+    hits = _findings(f"{rule_id.lower()}_clean.py")
+    assert not hits, hits
+
+
+def test_expected_flag_counts():
+    """Pin exact counts so rules don't silently widen or narrow: W1 sees
+    the orphan packer and the frame-count drift; W3 sees the bare decode
+    and the interprocedural chain; W5 sees the *_total gauge (twice:
+    naming + undocumented), the set(), and both bad inc() forms."""
+    assert len(_findings("w1_flagged.py", "W1")) == 2
+    assert len(_findings("w2_flagged.py", "W2")) == 1
+    assert len(_findings("w3_flagged.py", "W3")) == 2
+    assert len(_findings("w4_flagged.py", "W4")) == 1
+    assert len(_findings("w5_flagged.py", "W5")) == 5
+    assert len(_findings("w6_flagged.py", "W6")) == 2
+
+
+def test_w3_interprocedural_witness_names_the_chain():
+    hits = _findings("w3_flagged.py", "W3")
+    chained = [f for f in hits if "witness" in f.message]
+    assert len(chained) == 1
+    assert "_decode" in chained[0].message
+
+
+def test_w4_witness_names_recv_and_decode_lines():
+    (hit,) = _findings("w4_flagged.py", "W4")
+    assert "recv at line" in hit.message
+    assert "loads at line" in hit.message
+
+
+# -- historical replays -----------------------------------------------------
+
+
+def test_replay_recv_loop_kill_is_a_w3():
+    """PR 14's bug class: the master pump decoded straight off the socket
+    inside its poller loop — one corrupt frame killed every peer."""
+    hits = _findings("replay_w3_recv_kill.py", "W3")
+    assert len(hits) == 1
+    assert "PR 14" in hits[0].message
+    assert "master_pump" in hits[0].message
+    assert [f.rule for f in _findings("replay_w3_recv_kill.py")] == ["W3"]
+
+
+def test_replay_sign_mixed_counter_is_a_w5():
+    """PR 5's bug class: raw (sign-mixed) rewards accumulated into one
+    counter-typed series — rate() reads the dips as counter resets."""
+    hits = _findings("replay_w5_counter.py", "W5")
+    assert len(hits) == 1
+    assert "PR 5" in hits[0].message
+    assert "inc(-reward)" in hits[0].message
+    assert [f.rule for f in _findings("replay_w5_counter.py")] == ["W5"]
+
+
+# -- suppressions -----------------------------------------------------------
+
+
+def test_suppressions_silence_real_findings_both_forms():
+    raw = _findings("suppressed.py", "W6", suppress=False)
+    assert len(raw) == 2, raw  # trailing AND standalone form both land
+    assert _findings("suppressed.py") == []
+
+
+def test_docstring_mention_of_disable_is_not_a_suppression():
+    """Only real comment tokens suppress — documentation text that quotes
+    the syntax must neither mask findings nor read as stale."""
+    src = '"""uses # ba3cwire: disable=W3 like this"""\nx = 1\n'
+    assert suppressions(src, tool="ba3cwire") == {}
+    assert stale_suppressions(src, "d.py", [], "ba3cwire") == []
+
+
+def test_check_suppressions_flags_stale_comment():
+    _, ctx = _analyze("stale_suppressed.py", suppress=False)
+    (path, mod), = ctx.project.by_path.items()
+    out = stale_suppressions(mod.source, path, [], "ba3cwire")
+    assert [f.rule for f in out] == ["S001"]
+    assert "W2" in out[0].message
+
+
+# -- whole-repo gate --------------------------------------------------------
+
+
+def test_repo_is_wire_clean():
+    """The acceptance bar: the analyzer runs over the real codebase and
+    exits clean (true positives fixed, false positives suppressed with
+    justifications)."""
+    ctx = build_context(
+        [os.path.join(REPO_ROOT, "distributed_ba3c_tpu"),
+         os.path.join(REPO_ROOT, "tools")],
+        root=REPO_ROOT,
+    )
+    assert not ctx.project.broken
+    findings = filter_suppressed(ctx, run_rules(ctx, all_rules()))
+    assert findings == [], findings
+
+
+def test_repo_catalog_and_code_series_agree_both_ways():
+    """W5's cross-check is two-directional: every code series documented,
+    every documented series created — the repo must satisfy both."""
+    ctx = build_context(
+        [os.path.join(REPO_ROOT, "distributed_ba3c_tpu")], root=REPO_ROOT)
+    assert ctx.catalog is not None and ctx.has_metrics_module
+    declared = {d.name for d in ctx.series}
+    undocumented = {n for n in declared if not ctx.catalog.documents(n)}
+    assert undocumented == set(), undocumented
+    absent = {n for n in ctx.catalog.names if n not in declared}
+    assert absent == set(), absent
+
+
+# -- engine behavior --------------------------------------------------------
+
+
+def test_syntax_error_becomes_e001_not_a_crash(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    ctx = build_context([str(bad)], root=str(tmp_path))
+    out = run_rules(ctx, all_rules())
+    assert [f.rule for f in out] == ["E001"]
+
+
+def test_missing_catalog_disables_docs_checks(tmp_path):
+    """A sliced analysis with no docs/observability.md must not spam
+    undocumented-series findings — the docs contract only binds when the
+    catalog (and the metrics core) are in view."""
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "from distributed_ba3c_tpu import telemetry\n"
+        "c = telemetry.registry('x').counter('nowhere_documented_total')\n")
+    ctx = build_context([str(mod)], root=str(tmp_path))
+    assert ctx.catalog is None
+    out = run_rules(ctx, all_rules())
+    assert out == [], out
+
+
+# -- CLI contract -----------------------------------------------------------
+
+
+def test_cli_exit_one_on_findings_and_zero_on_clean():
+    assert _cli(_fx("w6_flagged.py")).returncode == 1
+    assert _cli(_fx("w6_clean.py")).returncode == 0
+
+
+def test_cli_select_unknown_rule_is_usage_error():
+    r = _cli("--select", "W99", _fx("w6_clean.py"))
+    assert r.returncode == 2
+    assert "W99" in r.stderr
+
+
+def test_cli_select_narrows_rules():
+    r = _cli("--select", "W2", _fx("w6_flagged.py"))
+    assert r.returncode == 0, r.stdout
+
+
+def test_cli_json_output_parses():
+    r = _cli("--json", _fx("w3_flagged.py"))
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    assert payload and payload[0]["rule"] == "W3"
+    assert payload[0]["line"] > 0
+
+
+def test_cli_list_rules():
+    r = _cli("--list-rules")
+    assert r.returncode == 0
+    for rid in RULE_IDS:
+        assert rid in r.stdout
+
+
+def test_cli_check_suppressions_exits_one_on_stale():
+    r = _cli("--check-suppressions", _fx("stale_suppressed.py"))
+    assert r.returncode == 1
+    assert "S001" in r.stdout
+    r = _cli("--check-suppressions", _fx("suppressed.py"))
+    assert r.returncode == 0, r.stdout
+
+
+def test_cli_sarif_output(tmp_path):
+    sarif_path = tmp_path / "wire.sarif"
+    r = _cli("--sarif", str(sarif_path), _fx("w1_flagged.py"))
+    assert r.returncode == 1
+    doc = json.loads(sarif_path.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "ba3cwire"
+    rule_ids = {rd["id"] for rd in run["tool"]["driver"]["rules"]}
+    assert set(RULE_IDS) <= rule_ids
+    results = run["results"]
+    assert results and all(res["ruleId"] == "W1" for res in results)
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("w1_flagged.py")
+    assert loc["region"]["startLine"] > 0

@@ -1,0 +1,74 @@
+"""Regression tests for the receive-loop decode guards (ba3cwire W3).
+
+These pin the PR-17 fixes for the four findings W3 raised on the live
+planes: the python simulator's action reply (actors/simulator.py) and the
+C++ env server's three reply paths (envs/native.py) now decode through
+fallback helpers — a corrupt reply repeats the previous action, bumps
+``corrupt_action_replies_total``, and the lockstep loop stays alive.
+"""
+
+import numpy as np
+
+from distributed_ba3c_tpu.actors.simulator import _decode_action as sim_decode
+from distributed_ba3c_tpu.envs.native import (
+    _decode_action as native_decode_one,
+    _decode_actions as native_decode_batch,
+)
+from distributed_ba3c_tpu.utils.serialize import dumps
+
+
+class _Counter:
+    def __init__(self):
+        self.n = 0
+
+    def inc(self, v=1):
+        self.n += v
+
+
+def test_simulator_decode_action_roundtrip():
+    c = _Counter()
+    assert sim_decode(dumps(3), 0, c) == 3
+    assert c.n == 0
+
+
+def test_simulator_decode_action_junk_repeats_previous():
+    c = _Counter()
+    assert sim_decode(b"\xff\x00garbage", 7, c) == 7
+    assert c.n == 1
+
+
+def test_native_decode_batch_roundtrip():
+    c = _Counter()
+    prev = np.zeros(4, np.int32)
+    raw = np.array([1, 2, 3, 4], np.int32).tobytes()
+    out = native_decode_batch(raw, prev, c)
+    assert out.tolist() == [1, 2, 3, 4]
+    assert c.n == 0
+
+
+def test_native_decode_batch_short_frame_repeats_previous():
+    """A truncated reply must not reach env.step with the wrong batch
+    shape — the fallback (previous actions) keeps lockstep intact."""
+    c = _Counter()
+    prev = np.array([5, 6, 7, 8], np.int32)
+    out = native_decode_batch(b"\x01\x00\x00\x00", prev, c)
+    assert out is prev
+    assert c.n == 1
+
+
+def test_native_decode_batch_unaligned_frame_repeats_previous():
+    """frombuffer raises on a byte count that isn't a multiple of the
+    itemsize — exactly the corrupt frame that used to kill the loop."""
+    c = _Counter()
+    prev = np.zeros(2, np.int32)
+    out = native_decode_batch(b"\x01\x02\x03", prev, c)
+    assert out is prev
+    assert c.n == 1
+
+
+def test_native_decode_one_roundtrip_and_junk():
+    c = _Counter()
+    assert native_decode_one(dumps(2), 0, c) == 2
+    assert c.n == 0
+    assert native_decode_one(b"not-msgpack\xff", 9, c) == 9
+    assert c.n == 1

@@ -1,0 +1,176 @@
+"""Shared scaffolding for the ba3clint / ba3cflow / ba3cwire analyzer family.
+
+Three analyzers, one surface contract: per-line ``# <tool>: disable=RULE``
+suppression comments, a ``--check-suppressions`` audit that reports dead
+suppressions as S001 findings, SARIF/JSON emission, and the 0/1/2 exit
+status scripts/check.sh and the CI jobs gate on. This module is the single
+implementation of that shared plumbing; the analyzers own only their rules
+and their project models.
+
+Import direction: the analyzers import from here, never the reverse.
+:class:`Finding` lives here too (it is what ``stale_suppressions`` emits),
+and is re-exported from ``tools.ba3clint.engine`` — the historical home
+every rule module and test imports it from.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import re
+import sys
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, \
+    Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# --------------------------------------------------------------------------
+# suppression comments
+# --------------------------------------------------------------------------
+
+_SUPPRESS_RE_CACHE: Dict[str, "re.Pattern[str]"] = {}
+
+
+def suppress_re(tool: str) -> "re.Pattern[str]":
+    pat = _SUPPRESS_RE_CACHE.get(tool)
+    if pat is None:
+        pat = re.compile(
+            r"#\s*" + re.escape(tool) + r":\s*disable=([A-Za-z0-9_*,\s-]+)")
+        _SUPPRESS_RE_CACHE[tool] = pat
+    return pat
+
+
+def suppressions(source: str, tool: str = "ba3clint") -> Dict[int, Set[str]]:
+    """Map line number -> suppressed rule ids (``ALL`` disables every rule).
+
+    A trailing comment suppresses its own line; a standalone comment line
+    suppresses the following line as well (for statements too long to carry
+    the comment inline). ``tool`` selects the comment spelling — ba3cflow
+    and ba3cwire reuse this parser with their own tool names.
+    """
+    pat = suppress_re(tool)
+    out: Dict[int, Set[str]] = {}
+    for i, text, standalone in comment_tokens(source):
+        m = pat.search(text)
+        if not m:
+            continue
+        rules = {
+            r.strip().upper()
+            for r in m.group(1).replace(";", ",").split(",")
+            if r.strip()
+        }
+        out.setdefault(i, set()).update(rules)
+        if standalone:
+            out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+def comment_tokens(source: str) -> Iterator[Tuple[int, str, bool]]:
+    """(line, comment text, is-standalone) for each REAL comment.
+
+    Tokenizing (rather than regex over raw lines) keeps ``disable=`` text
+    inside string literals — docstrings documenting the suppression syntax —
+    from acting as, or being audited as, a live suppression.
+    """
+    try:
+        toks = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        # unparseable tail: fall back to the raw-line scan so a suppression
+        # above the damage still works
+        for i, line in enumerate(source.splitlines(), start=1):
+            if "#" in line:
+                yield i, line[line.index("#"):], line.lstrip().startswith("#")
+        return
+    for tok in toks:
+        if tok.type == tokenize.COMMENT:
+            yield tok.start[0], tok.string, tok.line.lstrip().startswith("#")
+
+
+def stale_suppressions(source: str, path: str, raw: Sequence[Finding],
+                       tool: str) -> List[Finding]:
+    """Suppression comments in ``source`` that no longer mask any finding.
+
+    ``raw`` must be the UNSUPPRESSED findings for this file. Each rule id in
+    a ``disable=`` list is checked independently: disabling A6,A12 when only
+    A6 still fires reports A12 as stale. Stale suppressions are findings in
+    their own right (rule ``S001``) — a dead suppression is a claim about an
+    invariant the code no longer exercises, which misleads the next reader.
+    """
+    pat = suppress_re(tool)
+    by_line: Dict[int, Set[str]] = {}
+    for f in raw:
+        by_line.setdefault(f.line, set()).add(f.rule.upper())
+    out: List[Finding] = []
+    for i, text, standalone in comment_tokens(source):
+        m = pat.search(text)
+        if not m:
+            continue
+        covered = {i}
+        if standalone:
+            covered.add(i + 1)
+        fired: Set[str] = set()
+        for ln in covered:
+            fired |= by_line.get(ln, set())
+        rules = [r.strip().upper()
+                 for r in m.group(1).replace(";", ",").split(",")
+                 if r.strip()]
+        for rid in rules:
+            used = bool(fired) if rid == "ALL" else rid in fired
+            if not used:
+                out.append(Finding(
+                    path, i, 0, "S001",
+                    f"stale suppression: {tool}: disable={rid} masks no "
+                    f"finding on this line"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# CLI plumbing (exit status: 0 = clean, 1 = findings, 2 = bad usage)
+# --------------------------------------------------------------------------
+
+
+def print_rule_catalog(rules: Iterable) -> None:
+    for r in rules:
+        print(f"{r.id:4s} {r.name:32s} {r.summary}")
+
+
+def narrow_rules(rules: Sequence, select: str) -> Optional[List]:
+    """Apply ``--select``; None (after an stderr diagnostic) on unknown ids."""
+    wanted = {s.strip().upper() for s in select.split(",") if s.strip()}
+    unknown = wanted - {r.id for r in rules}
+    if unknown:
+        print(
+            f"unknown rule id(s): {', '.join(sorted(unknown))}",
+            file=sys.stderr,
+        )
+        return None
+    return [r for r in rules if r.id in wanted]
+
+
+def emit_findings(findings: Sequence[Finding], tool: str, rules: Iterable,
+                  as_json: bool, sarif: Optional[str]) -> int:
+    """SARIF side-channel + stdout report; returns the process exit status."""
+    if sarif:
+        from tools.sarif import write_sarif
+        write_sarif(sarif, findings, tool, rules)
+    if as_json:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f"{f.path}:{f.line}:{f.col + 1}: [{f.rule}] {f.message}")
+        n = len(findings)
+        print(f"{tool}: {n} finding{'s' if n != 1 else ''}")
+    return 1 if findings else 0

@@ -7,10 +7,11 @@ Exit status: 0 = clean, 1 = findings, 2 = bad usage. CI gates on this
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 from typing import List, Optional
 
+from tools.analyzer_core import emit_findings, narrow_rules, \
+    print_rule_catalog
 from tools.ba3clint import all_rules, lint_paths
 from tools.ba3clint.engine import check_suppressions
 
@@ -56,19 +57,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     rules = all_rules()
     if args.list_rules:
-        for r in rules:
-            print(f"{r.id:4s} {r.name:32s} {r.summary}")
+        print_rule_catalog(rules)
         return 0
     if args.select:
-        wanted = {s.strip().upper() for s in args.select.split(",") if s.strip()}
-        unknown = wanted - {r.id for r in rules}
-        if unknown:
-            print(
-                f"unknown rule id(s): {', '.join(sorted(unknown))}",
-                file=sys.stderr,
-            )
+        rules = narrow_rules(rules, args.select)
+        if rules is None:
             return 2
-        rules = [r for r in rules if r.id in wanted]
 
     try:
         if args.check_suppressions:
@@ -78,17 +72,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     except FileNotFoundError as e:
         print(f"ba3clint: {e}", file=sys.stderr)
         return 2
-    if args.sarif:
-        from tools.sarif import write_sarif
-        write_sarif(args.sarif, findings, "ba3clint", rules)
-    if args.format == "json":
-        print(json.dumps([f.to_dict() for f in findings], indent=2))
-    else:
-        for f in findings:
-            print(f"{f.path}:{f.line}:{f.col + 1}: [{f.rule}] {f.message}")
-        n = len(findings)
-        print(f"ba3clint: {n} finding{'s' if n != 1 else ''}")
-    return 1 if findings else 0
+    return emit_findings(findings, "ba3clint", rules,
+                         as_json=args.format == "json", sarif=args.sarif)
 
 
 if __name__ == "__main__":

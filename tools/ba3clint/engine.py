@@ -16,23 +16,20 @@ from __future__ import annotations
 
 import ast
 import dataclasses
-import io
 import os
-import re
-import tokenize
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
-
-@dataclasses.dataclass(frozen=True)
-class Finding:
-    path: str
-    line: int
-    col: int
-    rule: str
-    message: str
-
-    def to_dict(self) -> dict:
-        return dataclasses.asdict(self)
+# The suppression grammar, the S001 audit, and Finding itself are shared by
+# the whole analyzer family and live in tools.analyzer_core; they are
+# re-exported here because this module is their historical home (every rule
+# module and test imports them from tools.ba3clint.engine).
+from tools.analyzer_core import (  # noqa: F401  (re-exports)
+    Finding,
+    comment_tokens as _comment_tokens,
+    suppress_re as _suppress_re,
+    stale_suppressions,
+    suppressions,
+)
 
 
 class Rule:
@@ -44,106 +41,6 @@ class Rule:
 
     def check(self, ctx: "FileContext") -> Iterator[Finding]:
         raise NotImplementedError
-
-
-# --------------------------------------------------------------------------
-# suppression comments
-# --------------------------------------------------------------------------
-
-_SUPPRESS_RE_CACHE: Dict[str, "re.Pattern[str]"] = {}
-
-
-def _suppress_re(tool: str) -> "re.Pattern[str]":
-    pat = _SUPPRESS_RE_CACHE.get(tool)
-    if pat is None:
-        pat = re.compile(
-            r"#\s*" + re.escape(tool) + r":\s*disable=([A-Za-z0-9_*,\s-]+)")
-        _SUPPRESS_RE_CACHE[tool] = pat
-    return pat
-
-
-def suppressions(source: str, tool: str = "ba3clint") -> Dict[int, Set[str]]:
-    """Map line number -> suppressed rule ids (``ALL`` disables every rule).
-
-    A trailing comment suppresses its own line; a standalone comment line
-    suppresses the following line as well (for statements too long to carry
-    the comment inline). ``tool`` selects the comment spelling — ba3cflow
-    reuses this parser with ``tool="ba3cflow"``.
-    """
-    pat = _suppress_re(tool)
-    out: Dict[int, Set[str]] = {}
-    for i, text, standalone in _comment_tokens(source):
-        m = pat.search(text)
-        if not m:
-            continue
-        rules = {
-            r.strip().upper()
-            for r in m.group(1).replace(";", ",").split(",")
-            if r.strip()
-        }
-        out.setdefault(i, set()).update(rules)
-        if standalone:
-            out.setdefault(i + 1, set()).update(rules)
-    return out
-
-
-def _comment_tokens(source: str) -> Iterator[Tuple[int, str, bool]]:
-    """(line, comment text, is-standalone) for each REAL comment.
-
-    Tokenizing (rather than regex over raw lines) keeps ``disable=`` text
-    inside string literals — docstrings documenting the suppression syntax —
-    from acting as, or being audited as, a live suppression.
-    """
-    try:
-        toks = list(tokenize.generate_tokens(io.StringIO(source).readline))
-    except (tokenize.TokenError, SyntaxError, IndentationError):
-        # unparseable tail: fall back to the raw-line scan so a suppression
-        # above the damage still works
-        for i, line in enumerate(source.splitlines(), start=1):
-            if "#" in line:
-                yield i, line[line.index("#"):], line.lstrip().startswith("#")
-        return
-    for tok in toks:
-        if tok.type == tokenize.COMMENT:
-            yield tok.start[0], tok.string, tok.line.lstrip().startswith("#")
-
-
-def stale_suppressions(source: str, path: str, raw: Sequence[Finding],
-                       tool: str) -> List[Finding]:
-    """Suppression comments in ``source`` that no longer mask any finding.
-
-    ``raw`` must be the UNSUPPRESSED findings for this file. Each rule id in
-    a ``disable=`` list is checked independently: disabling A6,A12 when only
-    A6 still fires reports A12 as stale. Stale suppressions are findings in
-    their own right (rule ``S001``) — a dead suppression is a claim about an
-    invariant the code no longer exercises, which misleads the next reader.
-    """
-    pat = _suppress_re(tool)
-    by_line: Dict[int, Set[str]] = {}
-    for f in raw:
-        by_line.setdefault(f.line, set()).add(f.rule.upper())
-    out: List[Finding] = []
-    for i, text, standalone in _comment_tokens(source):
-        m = pat.search(text)
-        if not m:
-            continue
-        covered = {i}
-        if standalone:
-            covered.add(i + 1)
-        fired: Set[str] = set()
-        for ln in covered:
-            fired |= by_line.get(ln, set())
-        rules = [r.strip().upper()
-                 for r in m.group(1).replace(";", ",").split(",")
-                 if r.strip()]
-        for rid in rules:
-            used = bool(fired) if rid == "ALL" else rid in fired
-            if not used:
-                out.append(Finding(
-                    path, i, 0, "S001",
-                    f"stale suppression: {tool}: disable={rid} masks no "
-                    f"finding on this line"))
-    return out
 
 
 # --------------------------------------------------------------------------

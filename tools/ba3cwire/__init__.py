@@ -1,0 +1,45 @@
+"""ba3cwire: wire-protocol and failure-path conformance analyzer.
+
+Where ba3clint reads lines, ba3cflow reads the call graph, and ba3caudit
+reads jaxpr/HLO traces, ba3cwire reads the *protocol*: the codec planes
+(``utils/serialize``, ``pod/wire``, ``telemetry/wire``,
+``telemetry/tracing``), every socket receive path that decodes them, and
+the metrics contract that makes drops visible. Rule catalog (details in
+docs/static_analysis.md):
+
+- **W1** codec-pair symmetry: every pack/encode half has its unpack/decode
+  twin, and frame counts agree across the pair
+- **W2** header versioning discipline: length-versioned headers are
+  append-only with pinned positions; optional-element reads are guarded
+- **W3** receive-loop resilience: a decode inside a socket receive loop
+  must not let a corrupt frame kill the loop (PR 14 class)
+- **W4** typed-reject accounting: every handler that discards a message
+  increments a registered ``*_total`` reject/corrupt/stale counter
+- **W5** metrics-contract cross-check: code series vs the
+  docs/observability.md catalog, and counter monotonicity (PR 5 class)
+- **W6** CRC coverage: no wire channel bypasses the CRC-capable codec
+  layer when ``wire_crc`` is on
+
+Usage: ``python -m tools.ba3cwire [--json] [--sarif out.sarif]``.
+Suppress per line with ``# ba3cwire: disable=W3 — justification``.
+"""
+
+from tools.analyzer_core import Finding  # shared finding type
+from tools.ba3cwire.engine import WireContext, analyze_paths, build_context, \
+    filter_suppressed, run_rules
+
+
+def all_rules():
+    from tools.ba3cwire.rules import all_wire_rules
+    return all_wire_rules()
+
+
+__all__ = [
+    "Finding",
+    "WireContext",
+    "all_rules",
+    "analyze_paths",
+    "build_context",
+    "filter_suppressed",
+    "run_rules",
+]

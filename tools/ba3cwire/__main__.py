@@ -1,7 +1,8 @@
-"""CLI: ``python -m tools.ba3cflow [paths...]``.
+"""CLI: ``python -m tools.ba3cwire [paths...]``.
 
 Exit status: 0 = clean, 1 = findings, 2 = bad usage — same contract as
-ba3clint, so scripts/check.sh and the CI ``flow`` job gate on it directly.
+ba3clint/ba3cflow, so scripts/check.sh and the CI ``wire`` job gate on it
+directly.
 """
 
 from __future__ import annotations
@@ -12,17 +13,17 @@ from typing import List, Optional
 
 from tools.analyzer_core import emit_findings, narrow_rules, \
     print_rule_catalog, stale_suppressions
-from tools.ba3cflow import all_rules
-from tools.ba3cflow.engine import build_context, filter_suppressed, run_rules
+from tools.ba3cwire import all_rules
+from tools.ba3cwire.engine import build_context, filter_suppressed, run_rules
 
 DEFAULT_PATHS = ["distributed_ba3c_tpu", "tools"]
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
-        prog="python -m tools.ba3cflow",
-        description="Interprocedural concurrency/lifecycle analysis for the "
-        "BA3C stack (rule catalog: docs/static_analysis.md).",
+        prog="python -m tools.ba3cwire",
+        description="Wire-protocol/failure-path conformance analysis for "
+        "the BA3C stack (rule catalog: docs/static_analysis.md).",
     )
     parser.add_argument(
         "paths",
@@ -53,7 +54,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--check-suppressions",
         action="store_true",
-        help="flag '# ba3cflow: disable=' comments that mask no finding",
+        help="flag '# ba3cwire: disable=' comments that mask no finding",
     )
     args = parser.parse_args(argv)
 
@@ -69,7 +70,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         ctx = build_context(args.paths)
     except FileNotFoundError as e:
-        print(f"ba3cflow: {e}", file=sys.stderr)
+        print(f"ba3cwire: {e}", file=sys.stderr)
         return 2
     raw = run_rules(ctx, rules)
 
@@ -78,12 +79,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         for path, mod in sorted(ctx.project.by_path.items()):
             per_file = [f for f in raw if f.path == path]
             findings.extend(
-                stale_suppressions(mod.source, path, per_file, "ba3cflow"))
+                stale_suppressions(mod.source, path, per_file, "ba3cwire"))
         findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     else:
         findings = filter_suppressed(ctx, raw)
 
-    return emit_findings(findings, "ba3cflow", rules,
+    return emit_findings(findings, "ba3cwire", rules,
                          as_json=args.json, sarif=args.sarif)
 
 

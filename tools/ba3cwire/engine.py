@@ -1,0 +1,91 @@
+"""ba3cwire engine: context building, rule driving, suppression filtering.
+
+Same shape as ba3cflow's engine — whole-project rules over a shared
+context, :class:`~tools.analyzer_core.Finding` output, and the
+``# ba3cwire: disable=W3 — justification`` suppression spelling with the
+family's exact semantics (trailing comment covers its line, standalone
+comment covers the next line).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from tools.analyzer_core import Finding, suppressions
+from tools.ba3clint.engine import annotate_parents
+from tools.ba3cflow.graph import CallGraph, local_types
+from tools.ba3cflow.project import FunctionInfo, Project
+from tools.ba3cwire.model import Catalog, WireFacts, collect_series, \
+    load_catalog
+
+
+class WireContext:
+    """Everything a wire rule can ask about the project."""
+
+    def __init__(self, project: Project, root: str = "."):
+        self.project = project
+        for mod in project.by_path.values():
+            annotate_parents(mod.tree)
+        self.graph = CallGraph(project)
+        self.facts = WireFacts(project, self.graph)
+        self.series = collect_series(project)
+        self.catalog: Optional[Catalog] = load_catalog(root)
+        self.has_metrics_module = any(
+            mod.modname.endswith("telemetry.metrics")
+            for mod in project.by_path.values())
+        self._locals_cache: Dict[str, Dict[str, str]] = {}
+
+    def locals_of(self, fn: FunctionInfo) -> Dict[str, str]:
+        cached = self._locals_cache.get(fn.qualname)
+        if cached is None:
+            cached = local_types(self.project, fn)
+            self._locals_cache[fn.qualname] = cached
+        return cached
+
+
+def build_context(paths: Sequence[str], root: str = ".") -> WireContext:
+    return WireContext(Project.load(paths, root), root)
+
+
+def run_rules(ctx: WireContext, rules: Iterable) -> List[Finding]:
+    """All findings, unfiltered (suppressions NOT applied), sorted."""
+    out: List[Finding] = []
+    for path, err in sorted(ctx.project.broken.items()):
+        out.append(Finding(path, err.lineno or 1, (err.offset or 1) - 1,
+                           "E001", f"syntax error: {err.msg}"))
+    seen: Set[tuple] = set()
+    for rule in rules:
+        for f in rule.check(ctx):
+            key = (f.path, f.line, f.col, f.rule, f.message)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def filter_suppressed(ctx: WireContext,
+                      findings: Sequence[Finding]) -> List[Finding]:
+    sup_by_path: Dict[str, Dict[int, Set[str]]] = {}
+    out: List[Finding] = []
+    for f in findings:
+        mod = ctx.project.by_path.get(f.path)
+        if mod is None:
+            out.append(f)
+            continue
+        sup = sup_by_path.get(f.path)
+        if sup is None:
+            sup = suppressions(mod.source, tool="ba3cwire")
+            sup_by_path[f.path] = sup
+        disabled = sup.get(f.line, set())
+        if "ALL" in disabled or f.rule.upper() in disabled:
+            continue
+        out.append(f)
+    return out
+
+
+def analyze_paths(paths: Sequence[str], rules: Optional[Iterable] = None,
+                  root: str = ".") -> List[Finding]:
+    from tools.ba3cwire.rules import all_wire_rules
+    ctx = build_context(paths, root)
+    return filter_suppressed(ctx, run_rules(ctx, rules or all_wire_rules()))

@@ -1,0 +1,456 @@
+"""ba3cwire rules W1-W6: wire-protocol and failure-path conformance.
+
+Each rule is a class with ``id``/``name``/``summary`` and a ``check(ctx)``
+generator over a :class:`~tools.ba3cwire.engine.WireContext`. The catalog
+(docs/static_analysis.md) is the contract; fixtures under
+tests/lint_fixtures/wire/ pin each rule to a flagged/clean pair plus the
+two historical replays (PR 14's receive-loop kill, PR 5's sign-mixed
+counter).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.ba3clint.engine import Finding, dotted_name
+from tools.ba3cwire.model import (
+    HeaderAnalysis,
+    first_positional_param,
+    first_recv_line,
+    handler_catches_decode,
+    handler_reraises,
+    is_codec_module,
+    loop_protected_ids,
+    max_positional_index,
+    packer_frame_count,
+    recv_loops,
+    sign_guarded,
+    walk_scope,
+    walk_stmts,
+    wire_scope,
+)
+
+
+class WireRule:
+    """Base class: subclasses set ``id``/``name``/``summary`` and ``check``."""
+
+    id: str = ""
+    name: str = ""
+    summary: str = ""
+
+    def check(self, ctx) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+def _finding(rule: WireRule, path: str, node: ast.AST,
+             message: str) -> Finding:
+    return Finding(path, getattr(node, "lineno", 1),
+                   getattr(node, "col_offset", 0), rule.id, message)
+
+
+def _short(qual: str) -> str:
+    return ".".join(qual.split(".")[-3:])
+
+
+# --------------------------------------------------------------------------
+# W1: codec-pair symmetry
+# --------------------------------------------------------------------------
+
+_PAIR_PREFIXES = (("pack_", "unpack_"), ("encode_", "decode_"))
+
+
+class W1CodecPairSymmetry(WireRule):
+    """Every public ``pack_X``/``encode_X`` in a wire-scope module must have
+    a matching ``unpack_X``/``decode_X`` somewhere in the project (and vice
+    versa), and when a packer's frame count is statically certain, its
+    paired unpacker must not index past it. An orphan codec half means one
+    side of the wire ships a layout nobody can parse; an index overrun
+    means sender and receiver disagree on the layout — both are findings
+    here instead of runtime ``IndexError``s on a production socket.
+    """
+
+    id = "W1"
+    name = "codec-pair-symmetry"
+    summary = "pack/unpack halves must pair up and agree on frame layout"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        project = ctx.project
+        defined = {fn.name for fn in project.functions.values()}
+        for fn in sorted(project.functions.values(),
+                         key=lambda f: (f.path, f.node.lineno)):
+            mod = project.module_of(fn)
+            if not wire_scope(mod) or fn.name.startswith("_"):
+                continue
+            if fn.cls is not None:
+                continue  # methods pair through their class API, not names
+            for fwd, rev in _PAIR_PREFIXES:
+                if fn.name.startswith(fwd):
+                    suffix = fn.name[len(fwd):]
+                    mates = {rev + suffix, rev + suffix + "_full"}
+                    if not (mates & defined):
+                        yield _finding(
+                            self, fn.path, fn.node,
+                            f"packer {fn.name} has no {rev}{suffix} "
+                            f"counterpart — the wire layout it emits is "
+                            f"write-only (add the decoder or pair it "
+                            f"explicitly)")
+                        continue
+                    yield from self._frame_symmetry(ctx, fn, mates)
+                elif fn.name.startswith(rev):
+                    suffix = fn.name[len(rev):]
+                    if suffix.endswith("_full"):
+                        suffix = suffix[:-len("_full")]
+                    if fwd + suffix not in defined:
+                        yield _finding(
+                            self, fn.path, fn.node,
+                            f"unpacker {fn.name} has no {fwd}{suffix} "
+                            f"counterpart — it parses a layout nothing in "
+                            f"the project emits")
+
+    def _frame_symmetry(self, ctx, packer, mates) -> Iterator[Finding]:
+        count = packer_frame_count(packer.node)
+        if count is None:
+            return
+        for unpacker in ctx.project.functions.values():
+            if unpacker.name not in mates or unpacker.cls is not None:
+                continue
+            param = first_positional_param(unpacker.node)
+            if param is None:
+                continue
+            hit = max_positional_index(unpacker.node, param)
+            if hit is not None and hit[0] >= count:
+                yield _finding(
+                    self, unpacker.path, hit[1],
+                    f"{unpacker.name} indexes frame {hit[0]} of {param!r} "
+                    f"but its paired packer {packer.name} emits only "
+                    f"{count} frame{'s' if count != 1 else ''} — "
+                    f"sender/receiver layout drift")
+
+
+# --------------------------------------------------------------------------
+# W2: header versioning discipline
+# --------------------------------------------------------------------------
+
+
+class W2HeaderVersioning(WireRule):
+    """Length-versioned headers are append-only with pinned positions: the
+    base elements are validated once (``if len(h) < BASE: raise``), and
+    every read past the base is guarded by a length check
+    (``h[4] if len(h) > 4 else default``) so frames from old senders keep
+    parsing. A positional read at or past the validated/guarded base with
+    no covering guard is exactly the drift that turns a rolling upgrade
+    into an ``IndexError`` storm.
+    """
+
+    id = "W2"
+    name = "header-versioning-discipline"
+    summary = "optional header element read without a length/version guard"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for fn in sorted(ctx.project.functions.values(),
+                         key=lambda f: (f.path, f.node.lineno)):
+            if not wire_scope(ctx.project.module_of(fn)):
+                continue
+            ha = HeaderAnalysis(fn.node)
+            names = set(ha.validated) | set(ha.guards_seen)
+            for name in sorted(names):
+                base = ha.base_floor(name)
+                sym_floors = ha.symbolic_floors(name)
+                for sub, _nm, idx in ha.positional_reads(name):
+                    sym, off = idx
+                    if ha.guarded(sub, name, idx):
+                        continue
+                    if sym is None:
+                        if base is None or off < base:
+                            continue
+                        yield _finding(
+                            self, fn.path, sub,
+                            f"read of optional header element "
+                            f"{name}[{off}] is unguarded — the validated "
+                            f"base length is {base}; guard with "
+                            f"len({name}) > {off} so old senders keep "
+                            f"parsing (append-only, positions pinned)")
+                    else:
+                        floors = [fk for fsym, fk in sym_floors
+                                  if fsym == sym]
+                        guards = [fk for fsym, fk in
+                                  ha.guards_seen.get(name, [])
+                                  if fsym == sym]
+                        if not floors and not guards:
+                            continue  # convention unknown: stay quiet
+                        if any(off < fk for fk in floors):
+                            continue
+                        yield _finding(
+                            self, fn.path, sub,
+                            f"read of versioned header element "
+                            f"{name}[{sym} + {off}] is not covered by its "
+                            f"length validation — guard it or extend the "
+                            f"validated floor (append-only, positions "
+                            f"pinned)")
+
+
+# --------------------------------------------------------------------------
+# W3: receive-loop resilience
+# --------------------------------------------------------------------------
+
+
+class W3RecvLoopResilience(WireRule):
+    """Any decode reachable inside a socket receive loop must be wrapped so
+    typed decode errors (``CorruptFrameError``, msgpack errors, header
+    ``KeyError``/``ValueError``) continue the loop. A bare decode — or a
+    handler that re-raises/returns/breaks — means one corrupt frame from
+    one peer permanently kills the loop for every peer: the PR 14 class.
+    """
+
+    id = "W3"
+    name = "receive-loop-resilience"
+    summary = "decode inside a receive loop can kill it on a corrupt frame"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for fn in sorted(ctx.project.functions.values(),
+                         key=lambda f: (f.path, f.node.lineno)):
+            loops = recv_loops(fn.node)
+            if not loops:
+                continue
+            locals_ = ctx.locals_of(fn)
+            seen = set()
+            for loop in loops:
+                protected = loop_protected_ids(loop)
+                for call in walk_scope(loop):
+                    if not isinstance(call, ast.Call) or id(call) in seen:
+                        continue
+                    if id(call) in protected:
+                        continue
+                    hit = ctx.facts.raising_chain(fn, call, locals_)
+                    if hit is None:
+                        continue
+                    seen.add(id(call))
+                    chain, label = hit
+                    if not chain:
+                        yield _finding(
+                            self, fn.path, call,
+                            f"bare {label} inside the receive loop of "
+                            f"{_short(fn.qualname)} — a corrupt frame "
+                            f"raises out of the loop and kills it; catch "
+                            f"typed decode errors, count the reject, and "
+                            f"continue (PR 14 class)")
+                    else:
+                        witness = " -> ".join(
+                            _short(q) for q in (fn.qualname,) + chain)
+                        yield _finding(
+                            self, fn.path, call,
+                            f"call to {_short(chain[0])} can raise a "
+                            f"decode error inside the receive loop of "
+                            f"{_short(fn.qualname)} (witness: {witness}) "
+                            f"— wrap it so the loop continues, or contain "
+                            f"the error in the callee (PR 14 class)")
+
+
+# --------------------------------------------------------------------------
+# W4: typed-reject accounting
+# --------------------------------------------------------------------------
+
+
+class W4TypedRejectAccounting(WireRule):
+    """Every except branch that discards a wire message must increment a
+    registered ``*_total`` reject/corrupt/stale counter, directly or via a
+    callee. A silent swallow hides protocol rot: the fleet looks healthy
+    while frames quietly vanish — drops must be visible in /metrics with
+    the same fidelity as successes.
+    """
+
+    id = "W4"
+    name = "typed-reject-accounting"
+    summary = "decode-failure handler discards a message without counting it"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for fn in sorted(ctx.project.functions.values(),
+                         key=lambda f: (f.path, f.node.lineno)):
+            locals_ = None
+            for t in walk_scope(fn.node):
+                if not isinstance(t, ast.Try):
+                    continue
+                decodes: List[Tuple[ast.Call, str]] = []
+                for n in walk_stmts(t.body):
+                    if isinstance(n, ast.Call):
+                        if locals_ is None:
+                            locals_ = ctx.locals_of(fn)
+                        hit = ctx.facts.raising_chain(fn, n, locals_)
+                        if hit is not None:
+                            decodes.append((n, hit[1]))
+                if not decodes:
+                    continue
+                for h in t.handlers:
+                    if not handler_catches_decode(h) or handler_reraises(h):
+                        continue
+                    if ctx.facts.counts_reject(fn, h, locals_):
+                        continue
+                    recv_line = first_recv_line(fn.node)
+                    dnode, dlabel = decodes[0]
+                    witness = (f"recv at line {recv_line}, "
+                               if recv_line is not None else "")
+                    yield _finding(
+                        self, fn.path, h,
+                        f"decode-failure handler in "
+                        f"{_short(fn.qualname)} discards the message "
+                        f"without counting it ({witness}{dlabel} at line "
+                        f"{dnode.lineno}, swallowed here) — increment a "
+                        f"typed *_total reject/corrupt counter so drops "
+                        f"stay visible")
+
+
+# --------------------------------------------------------------------------
+# W5: metrics-contract cross-check
+# --------------------------------------------------------------------------
+
+
+class W5MetricsContract(WireRule):
+    """The series catalog in docs/observability.md IS the metrics contract:
+    every literal ``counter/gauge/histogram("name")`` in code must have a
+    catalog row and every catalog row a code-side series. ``*_total``
+    series are monotonic counters — never ``gauge``s, never ``set()``, and
+    ``inc()`` arguments must be non-negative (a negated increment needs a
+    dominating ``< 0`` sign-split guard: the PR 5 reward-sign class).
+    """
+
+    id = "W5"
+    name = "metrics-contract-cross-check"
+    summary = "series names, catalog rows, and counter monotonicity agree"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        catalog = ctx.catalog
+        declared = set()
+        for decl in ctx.series:
+            declared.add(decl.name)
+            if decl.kind == "gauge" and decl.name.endswith("_total"):
+                yield _finding(
+                    self, decl.path, decl.node,
+                    f"series {decl.name} is a gauge but *_total names a "
+                    f"monotonic counter — rename it or make it a counter")
+            if catalog is not None and not catalog.documents(decl.name):
+                yield _finding(
+                    self, decl.path, decl.node,
+                    f"series {decl.name} is not in the "
+                    f"docs/observability.md catalog — add a row (the "
+                    f"catalog is the dashboard/alerting contract)")
+        if catalog is not None and ctx.has_metrics_module:
+            for name, line in sorted(catalog.names.items()):
+                if name not in declared:
+                    yield Finding(
+                        catalog.display_path, line, 0, self.id,
+                        f"documented series {name} is not created anywhere "
+                        f"in the analyzed code — fix the catalog row or "
+                        f"restore the series")
+        yield from self._monotonicity(ctx)
+
+    def _monotonicity(self, ctx) -> Iterator[Finding]:
+        from tools.ba3cwire.model import counter_bindings
+        for path, mod in sorted(ctx.project.by_path.items()):
+            bindings = counter_bindings(mod)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Attribute):
+                    continue
+                series = self._counter_series(node.func.value, bindings)
+                if node.func.attr in ("set", "dec") and series is not None:
+                    yield _finding(
+                        self, path, node,
+                        f"counter {series} is {node.func.attr}() — "
+                        f"counters are monotonic; only inc() with a "
+                        f"non-negative value (PR 5 class)")
+                elif node.func.attr == "inc" and node.args:
+                    yield from self._inc_arg(ctx, path, node)
+
+    @staticmethod
+    def _counter_series(recv: ast.AST,
+                        bindings: Dict[str, str]) -> Optional[str]:
+        dn = dotted_name(recv)
+        if dn is not None and dn in bindings:
+            return bindings[dn]
+        if isinstance(recv, ast.Call) and \
+                isinstance(recv.func, ast.Attribute) and \
+                recv.func.attr == "counter" and recv.args and \
+                isinstance(recv.args[0], ast.Constant) and \
+                isinstance(recv.args[0].value, str):
+            return recv.args[0].value
+        return None
+
+    def _inc_arg(self, ctx, path: str, node: ast.Call) -> Iterator[Finding]:
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and \
+                isinstance(arg.value, (int, float)) and \
+                not isinstance(arg.value, bool) and arg.value < 0:
+            yield _finding(
+                self, path, node,
+                f"inc({arg.value}) decrements a counter — counters are "
+                f"monotonic (PR 5 class)")
+        elif isinstance(arg, ast.UnaryOp) and isinstance(arg.op, ast.USub):
+            operand = dotted_name(arg.operand)
+            if operand is None or not sign_guarded(node, operand):
+                yield _finding(
+                    self, path, node,
+                    f"negated increment inc(-{operand or '...'}) is not "
+                    f"dominated by a `{operand or '...'} < 0` guard — a "
+                    f"positive value here decrements the counter "
+                    f"(PR 5 class)")
+
+
+# --------------------------------------------------------------------------
+# W6: CRC coverage
+# --------------------------------------------------------------------------
+
+_CODEC_ENTRY_NAMES = {"dumps", "pack_block", "pack_params", "pack_experience"}
+
+
+class W6CrcCoverage(WireRule):
+    """With ``wire_crc`` on, frame integrity holds only if every channel
+    routes through the CRC-capable codec layer (utils/serialize and the
+    codecs built on it). A raw msgpack call outside the codec modules — or
+    an explicit ``crc=False`` at a non-codec call site — opens a channel
+    the CRC deployment story silently does not cover.
+    """
+
+    id = "W6"
+    name = "crc-coverage"
+    summary = "wire channel bypasses the CRC-capable codec layer"
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for path, mod in sorted(ctx.project.by_path.items()):
+            if is_codec_module(path):
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dn = dotted_name(node.func)
+                canon = mod.resolve(dn) if dn else None
+                if canon and canon.split(".")[0] == "msgpack":
+                    yield _finding(
+                        self, path, node,
+                        f"raw {canon} bypasses the CRC-capable codec "
+                        f"layer — route through utils/serialize "
+                        f"dumps/loads so wire_crc covers this channel")
+                    continue
+                last = dn.split(".")[-1] if dn else None
+                if last in _CODEC_ENTRY_NAMES:
+                    for kw in node.keywords:
+                        if kw.arg == "crc" and \
+                                isinstance(kw.value, ast.Constant) and \
+                                kw.value.value is False:
+                            yield _finding(
+                                self, path, node,
+                                f"{last}(crc=False) disables CRC framing "
+                                f"outside the codec layer — only the "
+                                f"codec modules may opt out (wire_crc "
+                                f"must cover every channel)")
+
+
+def all_wire_rules() -> List[WireRule]:
+    return [
+        W1CodecPairSymmetry(),
+        W2HeaderVersioning(),
+        W3RecvLoopResilience(),
+        W4TypedRejectAccounting(),
+        W5MetricsContract(),
+        W6CrcCoverage(),
+    ]
