@@ -212,6 +212,13 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--ingest_staging", default="on", choices=["on", "off"], help="ZMQ trainers: zero-copy pinned-staging ingest (data/staging.py) — collate writes obs bytes straight into preallocated double-buffered staging arrays (ONE host copy per block, ingest_copies_total proves it) and the next batch's H2D dispatches behind the running step. off = the legacy materialize->collate->device_put chain (the plane_bench --ingest foil)")
     p.add_argument("--rank_stall_timeout", type=float, default=0, help="multi-host: seconds without proven progress (beats land after the dispatch-window metrics fetch, after eval, and after the collective save) before a rank declares a peer dead and exits 75 (0 = default 600s when multi-host; -1 disables the watchdog; the limit self-raises to 2x the slowest healthy window). Relaunch with --load to resume")
     p.add_argument("--seed", type=int, default=0, help="fused trainer: PRNG seed for params/envs/action sampling (whole-trajectory determinism per seed; multi-seed runs disclose seed selection in RESULTS.md)")
+    p.add_argument(
+        "--dump_topology", action="store_true",
+        help="print the TopologySpec JSON this flag set describes and "
+        "exit (migration aid toward `python -m "
+        "distributed_ba3c_tpu.orchestrate --topology spec.json`; "
+        "docs/topology.md)",
+    )
     p.add_argument("--tpu_lock", default="wait", choices=["wait", "fail", "off"], help="host-local TPU-claim mutex (utils/devicelock.py): wait = queue behind the current holder, fail = exit with the holder's pid/run, off = no guard. CPU-platform runs never take the lock")
     return p
 
@@ -314,7 +321,8 @@ def _build_player_factory(args, cfg: BA3CConfig):
 
 
 def main(argv: Optional[list] = None) -> int:
-    args = make_parser().parse_args(argv)
+    parser = make_parser()
+    args = parser.parse_args(argv)
     nr_eval_explicit = args.nr_eval is not None
     if args.nr_eval is None:
         args.nr_eval = 8
@@ -326,125 +334,25 @@ def main(argv: Optional[list] = None) -> int:
         )
         return 0
 
-    # Pure-argparse validation BEFORE the lock: in wait mode a misconfigured
+    # Spec-level validation BEFORE the lock: in wait mode a misconfigured
     # run would otherwise queue for hours behind the holder only to fail on
     # a check that needs no device (jax-touching validation stays below —
     # env-module imports may init the backend, which must not precede the
-    # lock).
-    if (
-        args.task == "train"
-        and args.env.startswith("zmq:")
-        and not (args.pipe_c2s and args.pipe_s2c)
-    ):
-        raise SystemExit(
-            "--env zmq: means external env-server fleets feed this "
-            "learner — give them reachable endpoints via --pipe_c2s/"
-            "--pipe_s2c (e.g. tcp://0.0.0.0:5555 / tcp://0.0.0.0:5556)"
-        )
-    if args.steps_per_dispatch > 1 and args.steps_per_epoch % args.steps_per_dispatch:
-        raise SystemExit(
-            f"--steps_per_dispatch {args.steps_per_dispatch} must divide "
-            f"--steps_per_epoch {args.steps_per_epoch}"
-        )
-    if args.overlap and args.trainer != "tpu_fused_ba3c":
-        raise SystemExit(
-            "--overlap splits the FUSED trainer's program in two — it "
-            "requires --trainer tpu_fused_ba3c (the ZMQ trainers already "
-            "overlap actors and learner across processes)"
-        )
-    if args.fleets < 1:
-        raise SystemExit(f"--fleets must be >= 1, got {args.fleets}")
-    if args.fleets > 1 and (
-        args.task != "train" or args.trainer == "tpu_fused_ba3c"
-    ):
-        raise SystemExit(
-            "--fleets N runs N actor fleets against the ZMQ-plane "
-            "trainers' train task — the fused trainer has no actor plane "
-            "(its macro-batching knob is --fleet_accum with --overlap), "
-            "and eval/play spawn no fleet"
-        )
-    if args.fleet_accum < 1:
-        raise SystemExit(f"--fleet_accum must be >= 1, got {args.fleet_accum}")
-    if args.fleet_accum > 1 and not args.overlap:
-        raise SystemExit(
-            "--fleet_accum accumulates rollout windows in the overlap "
-            "trainer's macro learner — it requires --trainer "
-            "tpu_fused_ba3c --overlap (ZMQ-plane macro-batching is "
-            "--fleets N)"
-        )
-    # serving-plane flags belong to the predictor path; a fused run has no
-    # predictor, and a half-specified canary is a config typo — usage
-    # errors, never silently-ignored modifiers (repo convention)
-    serving_flags = (
-        args.serve_slo_ms or args.canary_load or args.shadow_load
-        or args.serve_replicas > 1 or args.serve_replicas_max
+    # lock). The rules themselves live in TopologySpec (orchestrate/
+    # topology.py) — the flag surface and a --topology document reject the
+    # SAME impossible deployments, as clean exit-2 usage errors.
+    from distributed_ba3c_tpu.orchestrate.topology import (
+        TopologyError,
+        TopologySpec,
     )
-    if serving_flags and (
-        args.task != "train" or args.trainer == "tpu_fused_ba3c"
-    ):
-        raise SystemExit(
-            "--serve_slo_ms/--canary_load/--shadow_load/--serve_replicas "
-            "configure the predictor serving plane — they apply to the "
-            "ZMQ-plane trainers' train task only (the fused trainer "
-            "serves actions inside its compiled program; eval/play are "
-            "synchronous)"
-        )
-    if args.serve_replicas < 1:
-        raise SystemExit(
-            f"--serve_replicas must be >= 1, got {args.serve_replicas}"
-        )
-    if args.serve_replicas_max:
-        if args.serve_replicas_max < args.serve_replicas:
-            raise SystemExit(
-                f"--serve_replicas_max {args.serve_replicas_max} < "
-                f"--serve_replicas {args.serve_replicas}"
-            )
-        if not args.serve_slo_ms:
-            raise SystemExit(
-                "--serve_replicas_max autoscales on the serving SLO — it "
-                "requires --serve_slo_ms (the watermark is served-p99 "
-                "against that budget)"
-            )
-    if args.canary_autopromote:
-        if not args.canary_load:
-            raise SystemExit(
-                "--canary_autopromote needs --canary_load (the candidate "
-                "checkpoint to canary)"
-            )
-        if args.serve_replicas < 2 or not args.serve_slo_ms:
-            raise SystemExit(
-                "--canary_autopromote runs on the serving ROUTER — it "
-                "requires --serve_replicas >= 2 and --serve_slo_ms (the "
-                "breach budget)"
-            )
-        if args.fleets > 1:
-            raise SystemExit(
-                "--canary_autopromote decides per router; with --fleets N "
-                "there are N independent routers and one canary decision "
-                "must not be made N times — run it single-fleet"
-            )
-    if bool(args.canary_load) != bool(args.canary_fraction > 0):
-        raise SystemExit(
-            "--canary_load and --canary_fraction come together: the "
-            "checkpoint names WHAT to canary, the fraction names HOW MUCH "
-            "traffic it gets"
-        )
-    if args.fleet_min or args.fleet_max:
-        if args.task != "train" or args.env.startswith("zmq:"):
-            raise SystemExit(
-                "--fleet_min/--fleet_max size a LOCALLY-supervised env "
-                "fleet — external zmq: fleets are supervised on their own "
-                "hosts (scripts/launch_env_fleet.py), and eval/play spawn "
-                "no fleet"
-            )
-        if (
-            args.fleet_min
-            and args.fleet_max
-            and args.fleet_min > args.fleet_max
-        ):
-            raise SystemExit(
-                f"--fleet_min {args.fleet_min} > --fleet_max {args.fleet_max}"
-            )
+
+    try:
+        topo = TopologySpec.from_flags(args)
+    except TopologyError as e:
+        parser.error(str(e))
+    if args.dump_topology:
+        print(topo.to_json())
+        return 0
 
     # Take the host-local TPU claim BEFORE the first jax backend touch: two
     # concurrent claimants don't error, they wedge the exclusive pool
@@ -686,9 +594,11 @@ def main(argv: Optional[list] = None) -> int:
             rollout_dtype=args.rollout_dtype,
         )
 
-    # extra serving-plane startables grown by the routed path (the
-    # per-fleet ReplicaAutoscaler, the fleet-0 PromotionController)
+    # serving-plane control loops grown by the routed path (the per-fleet
+    # ReplicaAutoscaler, the fleet-0 PromotionController) and the routed
+    # ReplicaSets themselves — all reconciler resources, named here
     serving_extras = []
+    replica_sets = []
 
     def make_predictor(k: int, tele_role: str):
         R = args.serve_replicas
@@ -736,7 +646,10 @@ def main(argv: Optional[list] = None) -> int:
             max_replicas=max(R, args.serve_replicas_max or R),
             warm=lambda p: p.warmup(cfg.state_shape),
         )
-        rs.start(R)
+        # the topology reconciler owns the dead-replica sweep (its
+        # ServingResource ticks rs.reconcile) — no per-set corpse thread
+        rs.start(R, reconcile_thread=False)
+        replica_sets.append((k, rs))
         # ONE startable handle for the whole routed plane: router.stop()
         # closes its owned ReplicaSet (replicas included)
         router.replica_set = rs
@@ -751,11 +664,11 @@ def main(argv: Optional[list] = None) -> int:
             else:
                 router.set_shadow("shadow")
         if args.serve_replicas_max and args.serve_replicas_max > R:
-            serving_extras.append(ReplicaAutoscaler(
+            serving_extras.append((f"serving-autoscaler-f{k}", ReplicaAutoscaler(
                 rs,
                 ServingScalerPolicy(slo_ms=args.serve_slo_ms),
                 interval_s=args.autoscale_interval,
-            ))
+            )))
         if args.canary_autopromote and k == 0:
             ctrl = PromotionController(
                 router,
@@ -766,7 +679,7 @@ def main(argv: Optional[list] = None) -> int:
                 p for n, p, _ in _policy_extras if n == "canary"
             )
             ctrl.start_canary(canary_params)
-            serving_extras.append(ctrl)
+            serving_extras.append(("canary-promotion", ctrl))
         return router
 
     if args.trainer == "tpu_vtrace_ba3c":
@@ -1069,8 +982,9 @@ def main(argv: Optional[list] = None) -> int:
         else []
     )
     # start order: every fleet's predictor+master, then the merge feed,
-    # then supervisors/autoscalers (spawning servers before their master's
-    # receive loop is live would park the whole fleet in its first recv)
+    # then ONE reconciler over every supervised resource (spawning servers
+    # before their master's receive loop is live would park the whole
+    # fleet in its first recv)
     startables = [pl.predictor for pl in planes]
     if multi_fleet:
         # the fan-out facade owns pump threads: it rides the same
@@ -1079,11 +993,36 @@ def main(argv: Optional[list] = None) -> int:
         startables.insert(0, predictor)
     startables += masters
     startables.append(feed)
-    startables += [pl.supervisor for pl in planes if pl.supervisor is not None]
-    startables += [pl.autoscaler for pl in planes if pl.autoscaler is not None]
-    # the routed serving plane's control loops (--serve_replicas_max
-    # autoscaler, --canary_autopromote controller) ride the same lifecycle
-    startables += serving_extras
+    # Every controller that used to ride the startables list on its own
+    # thread — fleet supervisors, fleet autoscalers, routed ReplicaSets'
+    # corpse sweep, the serving autoscaler/promotion loops — is now a
+    # resource of ONE generic reconcile loop (orchestrate/reconcile.py):
+    # observe → diff → act under the spec's backoff + restart-budget
+    # policy, every heal decision flight-recorded with its snapshot.
+    from distributed_ba3c_tpu.orchestrate import (
+        FleetResource,
+        PolicyResource,
+        Reconciler,
+        ServingResource,
+    )
+
+    reconciler = Reconciler(policy=topo.reconcile)
+    for pl in planes:
+        if pl.supervisor is not None:
+            reconciler.add(FleetResource(f"fleet{pl.fleet}", pl.supervisor))
+        if pl.autoscaler is not None:
+            reconciler.add(PolicyResource(
+                f"fleet-autoscaler-f{pl.fleet}", pl.autoscaler,
+                interval_s=pl.autoscaler.interval_s,
+            ))
+    for k, rs in replica_sets:
+        reconciler.add(ServingResource(f"serving-f{k}", rs))
+    for name, ctrl in serving_extras:
+        reconciler.add(PolicyResource(
+            name, ctrl, interval_s=ctrl.interval_s,
+        ))
+    if reconciler.resources():
+        startables.append(reconciler)
     callbacks = [
         StartProcOrThread(startables + tele_servers),
         HumanHyperParamSetter("learning_rate", shared_dir=base_logdir),

@@ -297,7 +297,7 @@ class NetChaosPlane:
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
         self._t0 = time.monotonic()
-        for p in self.proxies:
+        for p in self.proxies:  # ba3clint: disable=A15 — idempotent launch guard: each proxy starts at most once, nothing is respawned
             if not p.is_alive():
                 p.start()
         self._started = True

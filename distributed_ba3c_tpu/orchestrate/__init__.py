@@ -24,6 +24,15 @@ telemetry plane measures — ROADMAP open item 5:
 - :class:`MultihostLauncher` (orchestrate/multihost.py) — the retired
   scripts/launch_multihost.sh loop: rank derivation + exit-75 relaunch
   under the finalized-checkpoint resume gate (``--multihost``).
+- :class:`TopologySpec` (orchestrate/topology.py) — ONE declarative
+  document for the whole topology: fleets, pod hosts, learner, serving
+  replicas, SLO/staleness bounds, chaos/netchaos schedules
+  (docs/topology.md; ``--topology spec.json`` / ``--dump_topology``).
+- :class:`Reconciler` (orchestrate/reconcile.py) — the single generic
+  observe→diff→act loop driving every resource above through one
+  :class:`Reconcilable` protocol, with per-resource backoff, a
+  topology-wide restart-budget circuit breaker, and flight-recorded
+  decisions (``tele/reconciler/*``).
 
 Every decision is exported as ``tele/orchestrator/*`` series and
 flight-recorder events — scale/respawn/failover actions are always
@@ -51,8 +60,21 @@ from distributed_ba3c_tpu.orchestrate.pod import (  # noqa: F401
     PodSupervisor,
     host_argv,
 )
+from distributed_ba3c_tpu.orchestrate.reconcile import (  # noqa: F401
+    Action,
+    FleetResource,
+    LearnerResource,
+    PolicyResource,
+    Reconcilable,
+    Reconciler,
+    ServingResource,
+)
 from distributed_ba3c_tpu.orchestrate.spec import FleetSpec  # noqa: F401
 from distributed_ba3c_tpu.orchestrate.supervisor import (  # noqa: F401
     FleetSupervisor,
     default_factory,
+)
+from distributed_ba3c_tpu.orchestrate.topology import (  # noqa: F401
+    TopologyError,
+    TopologySpec,
 )

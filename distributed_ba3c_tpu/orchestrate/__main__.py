@@ -29,12 +29,25 @@ tcp pipe base::
     python -m distributed_ba3c_tpu.orchestrate --pod_hosts 2 \\
         --pipe_c2s tcp://127.0.0.1:15555 --pipe_s2c tcp://127.0.0.1:15556 \\
         --logdir runs/pod --updates 500
+
+**Topology mode** (``--topology spec.json``, docs/topology.md): run ONE
+reconciler over a whole declarative :class:`TopologySpec` — env-server
+fleets, pod actor hosts, and the supervised learner, healed to spec by
+the generic observe→diff→act loop (orchestrate/reconcile.py)::
+
+    python -m distributed_ba3c_tpu.orchestrate --topology spec.json
+
+Emit a spec from any existing cli.py flag set with ``--dump_topology``
+(migration aid). A serving section needs the learner process's router:
+it rides INSIDE the learner child (the spec's ``learner.train_args``
+carry the ``--serve_*`` flags), not in this orchestrator process.
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
+import time
 from typing import List, Optional
 
 from distributed_ba3c_tpu import telemetry
@@ -48,6 +61,12 @@ def make_parser() -> argparse.ArgumentParser:
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     p.add_argument("--logdir", default=None, help="the run's logdir (same value train.py gets); required outside --multihost")
+    p.add_argument(
+        "--topology", default=None, metavar="SPEC.JSON",
+        help="topology mode: reconcile the whole declarative TopologySpec "
+        "(fleets, pod hosts, supervised learner) with one generic loop "
+        "(docs/topology.md)",
+    )
     p.add_argument("--max_restarts", type=int, default=5)
     p.add_argument(
         "--stall_secs", type=float, default=0,
@@ -84,6 +103,89 @@ def make_parser() -> argparse.ArgumentParser:
     return p
 
 
+def run_topology(spec_path: str, p: argparse.ArgumentParser) -> int:
+    """Reconcile a whole TopologySpec with one generic loop: fleets, pod
+    actor hosts and the supervised learner as Reconcilable resources.
+    Spec problems are usage errors (exit 2), never tracebacks."""
+    from distributed_ba3c_tpu.orchestrate.pod import PodSupervisor, host_argv
+    from distributed_ba3c_tpu.orchestrate.reconcile import (
+        FleetResource,
+        LearnerResource,
+        Reconciler,
+    )
+    from distributed_ba3c_tpu.orchestrate.supervisor import FleetSupervisor
+    from distributed_ba3c_tpu.orchestrate.topology import (
+        TopologyError,
+        TopologySpec,
+    )
+
+    try:
+        spec = TopologySpec.load(spec_path)
+    except TopologyError as e:
+        p.error(str(e))
+    if spec.serving is not None and spec.learner is None:
+        p.error(
+            "a serving section rides INSIDE the learner process (its "
+            "router lives there) — give the spec a learner whose "
+            "train_args carry the --serve_* flags, or drop the section"
+        )
+    if spec.learner is not None:
+        telemetry.configure(spec.learner.logdir)
+    rec = Reconciler(policy=spec.reconcile)  # ba3cflow: disable=F5 — the finally's rec.close() stops AND joins the loop thread (Reconciler.close)
+    for k, fleet in enumerate(spec.fleets):
+        rec.add(FleetResource(f"fleet{k}", FleetSupervisor(fleet)))
+    if spec.pod is not None:
+        pod = spec.pod
+        if not (pod.pipe_c2s and pod.pipe_s2c):
+            p.error(
+                "pod.pipe_c2s/pod.pipe_s2c must name the learner's pipe "
+                "pair the supervised hosts connect to"
+            )
+        rec.add(FleetResource(
+            "pod-hosts",
+            PodSupervisor(
+                pod.hosts,
+                lambda i: host_argv(
+                    i, pod.pipe_c2s, pod.pipe_s2c, env=pod.env,
+                    n_sims=pod.sims_per_host,
+                    max_staleness=max(0, pod.max_staleness),
+                ),
+                backoff_base_s=pod.backoff_base_s,
+            ),
+            kind="pod",
+        ))
+    learner_res = None
+    if spec.learner is not None:
+        lt = spec.learner
+        try:
+            sup = LearnerSupervisor(
+                lt.logdir,
+                list(lt.train_args),
+                max_restarts=lt.max_restarts,
+                stall_secs=lt.stall_secs,
+                startup_grace_s=lt.startup_grace_s,
+                poll_s=lt.poll_s,
+            )
+        except ValueError as e:  # train_args --logdir/--load misuse
+            p.error(str(e))
+        learner_res = rec.add(LearnerResource("learner", sup))
+    if not rec.resources():
+        p.error(
+            "the topology names nothing this orchestrator can run — add "
+            "fleets, a pod section, or a learner section"
+        )
+    rec.start()
+    try:
+        while True:
+            if learner_res is not None and learner_res.final_rc is not None:
+                return learner_res.final_rc
+            time.sleep(spec.reconcile.poll_interval_s)
+    except KeyboardInterrupt:
+        return 130
+    finally:
+        rec.close()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if "--" in argv:
@@ -96,6 +198,19 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.multihost and args.pod_hosts:
         p.error("--multihost and --pod_hosts are different modes — pick one")
+
+    if args.topology:
+        if args.multihost or args.pod_hosts:
+            p.error(
+                "--topology is its own mode: the spec document carries "
+                "the pod/learner sections"
+            )
+        if train_args:
+            p.error(
+                "--topology takes no train.py arguments after '--' — the "
+                "spec's learner.train_args carry them"
+            )
+        return run_topology(args.topology, p)
 
     if args.multihost:
         from distributed_ba3c_tpu.orchestrate.multihost import MultihostLauncher

@@ -116,57 +116,28 @@ class LearnerSupervisor:
         self.poll_s = poll_s
         self.attempt = 0
         self.child_pid: Optional[int] = None  # the live attempt's pid
+        self._child: Optional[subprocess.Popen] = None
+        self._start_mono = 0.0
+        self._start_wall = 0.0
+        self._stall_killed = False
         self._flight = telemetry.flight_recorder()
         tele = telemetry.registry("orchestrator")
         self._c_restarts = tele.counter("learner_restarts_total")
         self._c_resumes = tele.counter("learner_resumes_total")
         self._g_attempt = tele.gauge("learner_attempt")
 
-    def run(self) -> int:
-        """Blocking supervision loop; returns the final exit code (0 =
-        the learner finished cleanly, possibly across several resumes)."""
-        while True:
-            rc = self._run_attempt()
-            if rc == 0:
-                logger.info(
-                    "learner finished cleanly after %d restart(s)",
-                    self.attempt,
-                )
-                return 0
-            self.attempt += 1
-            if self.attempt > self.max_restarts:
-                logger.error(
-                    "learner giving up after %d restarts (rc=%s)",
-                    self.max_restarts, rc,
-                )
-                self._flight.record(
-                    "learner_giveup", rc=rc, attempts=self.attempt
-                )
-                self._flight.dump("learner restart budget exhausted")
-                return rc
-            step = finalized_step(self.ckpt_dir)
-            self._c_restarts.inc()
-            if step is not None:
-                self._c_resumes.inc()
-            # the failover IS the postmortem moment: the next operator to
-            # look must find on disk that the learner died with rc=<x> and
-            # resumed from step <y> — without having watched the console
-            self._flight.record(
-                "learner_failover",
-                rc=rc,
-                attempt=self.attempt,
-                resume_step=step,
-            )
-            self._flight.dump("learner failover")
-            logger.warn(
-                "learner died (rc=%s) — attempt %d/%d %s",
-                rc, self.attempt, self.max_restarts,
-                f"resuming from finalized step {step}"
-                if step is not None
-                else "restarting from scratch (no finalized checkpoint)",
-            )
+    # -- non-blocking attempt primitives -----------------------------------
+    # The blocking run() below and the reconciler's LearnerResource
+    # (orchestrate/reconcile.py) are the SAME state machine: these
+    # primitives are its only implementation, so failover accounting
+    # cannot drift between the two drivers.
 
-    def _run_attempt(self) -> int:
+    def start_attempt(self) -> None:
+        """Launch the next attempt through the resume gate (``--load``
+        exactly when a finalized checkpoint exists). No-op while an
+        attempt is live."""
+        if self.attempt_running():
+            return
         args = list(self.train_args)
         if finalized_step(self.ckpt_dir) is not None:
             args += ["--load", self.ckpt_dir]
@@ -181,37 +152,134 @@ class LearnerSupervisor:
         child = subprocess.Popen(
             [self.python, self.train_py] + args, start_new_session=True
         )
+        self._child = child
         self.child_pid = child.pid
-        start = time.monotonic()
+        self._start_mono = time.monotonic()
         # wall clock on purpose: stall progress is the log FILE's st_mtime,
         # which only compares against wall time
-        start_wall = time.time()  # ba3clint: disable=A4
-        log_path = os.path.join(self.logdir, "log.log")
+        self._start_wall = time.time()  # ba3clint: disable=A4
+        self._stall_killed = False
+
+    def attempt_running(self) -> bool:
+        return self._child is not None and self._child.poll() is None
+
+    def attempt_stalled(self) -> bool:
+        """The stall watchdog's verdict on the LIVE attempt (always
+        False with the watchdog disabled or no attempt running)."""
+        if self.stall_secs <= 0 or not self.attempt_running():
+            return False
+        return self._stalled(
+            os.path.join(self.logdir, "log.log"), self._start_wall
+        )
+
+    def kill_attempt(self, reason: str = "stall") -> None:
+        """Kill the live attempt's process group (stall recovery); the
+        next :meth:`reap_attempt` reports it as a non-zero exit so the
+        resume path takes over."""
+        child = self._child
+        if child is None or child.poll() is not None:
+            return
+        age = time.monotonic() - self._start_mono
+        logger.warn(
+            "[learner supervisor] %s after %.0fs — killing group %d",
+            reason, age, child.pid,
+        )
+        self._flight.record(
+            "learner_stall_kill", pid=child.pid, age_s=round(age, 1)
+        )
+        self._stall_killed = True
+        self._kill_group(child)
+        child.wait()
+
+    def reap_attempt(self) -> Optional[int]:
+        """The attempt's exit code once it has exited (reaping it), else
+        None. A stall-killed attempt reports at least 1 even if the
+        group died with rc 0."""
+        child = self._child
+        if child is None:
+            return None
+        rc = child.poll()
+        if rc is None:
+            return None
+        self._child = None
+        self.child_pid = None
+        if self._stall_killed:
+            rc = rc or 1
+        return rc
+
+    def note_exit(self, rc: int) -> str:
+        """Account one attempt's exit: ``"done"`` (clean finish),
+        ``"retry"`` (failover armed — counters bumped, flight event +
+        dump written), or ``"giveup"`` (restart budget exhausted)."""
+        if rc == 0:
+            logger.info(
+                "learner finished cleanly after %d restart(s)", self.attempt
+            )
+            return "done"
+        self.attempt += 1
+        if self.attempt > self.max_restarts:
+            logger.error(
+                "learner giving up after %d restarts (rc=%s)",
+                self.max_restarts, rc,
+            )
+            self._flight.record(
+                "learner_giveup", rc=rc, attempts=self.attempt
+            )
+            self._flight.dump("learner restart budget exhausted")
+            return "giveup"
+        step = finalized_step(self.ckpt_dir)
+        self._c_restarts.inc()
+        if step is not None:
+            self._c_resumes.inc()
+        # the failover IS the postmortem moment: the next operator to
+        # look must find on disk that the learner died with rc=<x> and
+        # resumed from step <y> — without having watched the console
+        self._flight.record(
+            "learner_failover",
+            rc=rc,
+            attempt=self.attempt,
+            resume_step=step,
+        )
+        self._flight.dump("learner failover")
+        logger.warn(
+            "learner died (rc=%s) — attempt %d/%d %s",
+            rc, self.attempt, self.max_restarts,
+            f"resuming from finalized step {step}"
+            if step is not None
+            else "restarting from scratch (no finalized checkpoint)",
+        )
+        return "retry"
+
+    def terminate_attempt(self) -> None:
+        """Teardown: kill and reap the live attempt, if any
+        (idempotent)."""
+        child = self._child
+        self._child = None
+        self.child_pid = None
+        if child is not None and child.poll() is None:
+            self._kill_group(child)
+            child.wait()
+
+    def run(self) -> int:
+        """Blocking supervision loop; returns the final exit code (0 =
+        the learner finished cleanly, possibly across several resumes)."""
         try:
+            self.start_attempt()
             while True:
-                rc = child.poll()
+                rc = self.reap_attempt()
                 if rc is not None:
-                    return rc
-                if self.stall_secs > 0 and self._stalled(
-                    log_path, start_wall
-                ):
-                    age = time.monotonic() - start
-                    logger.warn(
-                        "[learner supervisor] stall after %.0fs — killing "
-                        "group %d", age, child.pid,
-                    )
-                    self._flight.record(
-                        "learner_stall_kill", pid=child.pid,
-                        age_s=round(age, 1),
-                    )
-                    self._kill_group(child)
-                    return child.wait() or 1
-                time.sleep(self.poll_s)
+                    verdict = self.note_exit(rc)
+                    if verdict == "done":
+                        return 0
+                    if verdict == "giveup":
+                        return rc
+                    self.start_attempt()
+                elif self.attempt_stalled():
+                    self.kill_attempt()
+                else:
+                    time.sleep(self.poll_s)
         finally:
-            self.child_pid = None
-            if child.poll() is None:
-                self._kill_group(child)
-                child.wait()
+            self.terminate_attempt()
 
     def _stalled(self, log_path: str, attempt_start_wall: float) -> bool:
         """The shell watchdog's rule: progress = the run log's mtime;

@@ -108,14 +108,20 @@ class ReplicaSet:
         )
 
     # -- lifecycle ---------------------------------------------------------
-    def start(self, n: Optional[int] = None) -> None:
+    def start(
+        self, n: Optional[int] = None, reconcile_thread: bool = True
+    ) -> None:
         """Spawn the initial replicas (default: ``min_replicas``) and
-        start the dead-replica reconcile loop."""
+        start the dead-replica reconcile loop. ``reconcile_thread=False``
+        leaves the sweeping to an external driver (the topology
+        reconciler, orchestrate/reconcile.py, calls :meth:`reconcile`
+        from its own tick) — close() handles either mode."""
         n = self.min_replicas if n is None else n
         n = max(self.min_replicas, min(self.max_replicas, n))
         for _ in range(n):
             self._spawn()
-        self._reconcile_thread.start()
+        if reconcile_thread:
+            self._reconcile_thread.start()
 
     def close(self) -> None:
         """Stop every replica (teardown; queued tasks get the typed

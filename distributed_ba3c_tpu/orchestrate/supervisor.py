@@ -185,14 +185,21 @@ class FleetSupervisor(StoppableThread):
         )
 
     # -- lifecycle (StartProcOrThread protocol) ----------------------------
-    def start(self) -> None:
-        """Spawn the initial fleet, then start the supervision loop."""
+    def spawn_initial(self) -> None:
+        """Spawn the initial fleet (idempotent). Split out of
+        :meth:`start` so a reconciler can bring the fleet up without
+        starting the supervisor's own thread (orchestrate/reconcile.py
+        owns the tick in that mode)."""
         with self._lock:
             if not self._fleet_started:
                 self._fleet_started = True
                 for i in range(self._target):
                     self._slots[i] = _Slot(i)
                     self._spawn(self._slots[i])
+
+    def start(self) -> None:
+        """Spawn the initial fleet, then start the supervision loop."""
+        self.spawn_initial()
         super().start()
         logger.info(
             "fleet supervisor up: %d/%d servers (bounds [%d, %d], wire %s)",
@@ -238,6 +245,64 @@ class FleetSupervisor(StoppableThread):
                 pass
 
     # -- introspection -----------------------------------------------------
+    def observe(self) -> Dict[str, object]:
+        """Read-only snapshot of desired vs live, in the Reconcilable
+        protocol's shape (orchestrate/reconcile.py): slot liveness from
+        the process table, wedge suspects from the master's prune stream
+        (peeked — the cursor is only consumed by the tick that acts).
+        Dead-but-unreaped slots report as due: the reap IS the pending
+        action."""
+        now = time.monotonic()
+        wedged = self._wedge_suspects()
+        with self._lock:
+            retired_idxs = {idx for idx, _, _ in self._retired}
+            live: List[int] = []
+            due: List[int] = []
+            backoff: List[int] = []
+            for slot in sorted(self._slots.values(), key=lambda s: s.idx):
+                p = slot.proc
+                if p is not None and p.is_alive():
+                    live.append(slot.idx)
+                elif p is not None:
+                    due.append(slot.idx)  # dead, reap pending
+                elif slot.idx in retired_idxs or now < slot.next_spawn_t:
+                    backoff.append(slot.idx)
+                else:
+                    due.append(slot.idx)
+            return {
+                "kind": "fleet",
+                "target": self._target,
+                "live": tuple(live),
+                "vacant_due": tuple(due),
+                "vacant_backoff": tuple(backoff),
+                "retiring": tuple(sorted(retired_idxs)),
+                "wedged": tuple(wedged),
+                "circuit_open": self._circuit_open,
+                "ever_started": self._fleet_started,
+            }
+
+    def _wedge_suspects(self) -> List[int]:
+        """Slots the master has pruned whose process is still alive —
+        the same verdict :meth:`_kill_wedged` acts on, WITHOUT advancing
+        the event cursor or killing anything."""
+        events = self._flight.events_since(self._events_after, kind="prune")
+        out = set()
+        for t, _, fields in events:
+            ident_repr = str(fields.get("ident", ""))
+            with self._lock:
+                idx = self._slot_for_ident(ident_repr)
+                slot = self._slots.get(idx) if idx is not None else None
+                proc = slot.proc if slot is not None else None
+                stale = slot is None or t <= slot.started_t
+            if proc is not None and not stale and proc.is_alive():
+                out.add(slot.idx)
+        return sorted(out)
+
+    def tick(self) -> None:
+        """One full supervision pass, caller-driven (the reconciler's
+        ``act``). Identical to one iteration of :meth:`run`."""
+        self._tick()
+
     def live_count(self) -> int:
         with self._lock:
             return sum(

@@ -1,0 +1,605 @@
+"""TopologySpec: the WHOLE deployment as one declarative document.
+
+FleetSpec (orchestrate/spec.py) made one fleet reviewable as data; this
+module extends the same frozen, JSON-round-tripping, unknown-field-
+rejecting pattern to the full topology — fleets, pod hosts, the learner,
+serving replicas, SLO/staleness bounds, and the chaos/netchaos schedules
+— so a deployment is ONE document the reconcile loop
+(orchestrate/reconcile.py) heals toward, and a topology change is a spec
+edit, not a cli.py rewiring (ROADMAP item 5, docs/topology.md).
+
+Validation is the spec's job, not the flag parser's: every half-specified
+combo cli.py used to police inline (a canary without a load, serving
+flags on the fused trainer, fleet bounds around an external fleet) is a
+:class:`TopologyError` raised at construction, which both entry points
+(cli.py, ``python -m distributed_ba3c_tpu.orchestrate --topology``)
+convert to a clean exit-2 usage error — junk, truncated or type-confused
+JSON must never escape as a raw traceback (the fuzz suite in
+tests/test_topology.py pins this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+from distributed_ba3c_tpu.orchestrate.spec import FleetSpec
+
+
+class TopologyError(ValueError):
+    """A spec that names an impossible deployment (usage error, exit 2)."""
+
+
+def _dataclass_from_doc(cls, doc: Any, where: str):
+    """The FleetSpec unknown-field contract, applied at every nesting
+    level: a typoed knob fails the launch, never silently runs with the
+    default it was trying to override."""
+    if not isinstance(doc, Mapping):
+        raise TopologyError(
+            f"{where} must be a JSON object, got {type(doc).__name__}"
+        )
+    known = {f.name for f in dataclasses.fields(cls)}
+    unknown = sorted(set(doc) - known)
+    if unknown:
+        raise TopologyError(f"unknown {where} fields: {unknown}")
+    try:
+        return cls(**doc)
+    except TopologyError:
+        raise
+    except (TypeError, ValueError) as e:
+        raise TopologyError(f"bad {where}: {e}") from None
+
+
+@dataclasses.dataclass(frozen=True)
+class LearnerTopology:
+    """The supervised learner: train.py args + the resume/watchdog policy
+    (orchestrate/learner.py LearnerSupervisor's surface)."""
+
+    logdir: str = ""
+    #: train.py argv (must include a matching --logdir, never --load —
+    #: the resume gate owns --load; LearnerSupervisor validates)
+    train_args: Tuple[str, ...] = ()
+    max_restarts: int = 5
+    stall_secs: float = 0.0
+    startup_grace_s: float = 600.0
+    poll_s: float = 1.0
+
+    def __post_init__(self):
+        object.__setattr__(self, "train_args", tuple(
+            str(a) for a in self.train_args
+        ))
+        if not self.logdir:
+            raise TopologyError("learner.logdir must be set")
+        if self.max_restarts < 0:
+            raise TopologyError("learner.max_restarts must be >= 0")
+        if self.stall_secs < 0 or self.startup_grace_s < 0:
+            raise TopologyError("learner stall/grace must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class PodTopology:
+    """A pod of whole actor hosts (orchestrate/pod.py PodSupervisor) and
+    the learner-side staleness bound the pod plane gates on."""
+
+    hosts: int = 2
+    sims_per_host: int = 2
+    pipe_c2s: str = ""
+    pipe_s2c: str = ""
+    env: str = "fake"
+    #: bounded-staleness gate (docs/pod.md): -1 = unbounded
+    max_staleness: int = -1
+    restart_budget: int = 16
+    budget_window_s: float = 300.0
+    backoff_base_s: float = 0.5
+    backoff_max_s: float = 30.0
+
+    def __post_init__(self):
+        if self.hosts < 1:
+            raise TopologyError(f"pod.hosts must be >= 1, got {self.hosts}")
+        if self.sims_per_host < 1:
+            raise TopologyError("pod.sims_per_host must be >= 1")
+        if self.max_staleness < -1:
+            raise TopologyError(
+                "pod.max_staleness is a version lag (-1 = unbounded), got "
+                f"{self.max_staleness}"
+            )
+        if self.restart_budget < 0:
+            raise TopologyError("pod.restart_budget must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingTopology:
+    """The serving tier: replica count/bounds behind the SLO router, plus
+    the canary/shadow policy table (docs/serving.md)."""
+
+    replicas: int = 1
+    replicas_max: int = 0  # 0 = fixed count (no autoscaler)
+    slo_ms: float = 0.0
+    canary_load: str = ""
+    canary_fraction: float = 0.0
+    canary_autopromote: bool = False
+    shadow_load: str = ""
+    autoscale_interval_s: float = 5.0
+
+    def __post_init__(self):
+        if self.replicas < 1:
+            raise TopologyError(
+                f"serving.replicas must be >= 1, got {self.replicas}"
+            )
+        if self.replicas_max:
+            if self.replicas_max < self.replicas:
+                raise TopologyError(
+                    f"serving.replicas_max {self.replicas_max} < "
+                    f"serving.replicas {self.replicas}"
+                )
+            if not self.slo_ms:
+                raise TopologyError(
+                    "serving.replicas_max autoscales on the serving SLO — "
+                    "it requires serving.slo_ms (the watermark is "
+                    "served-p99 against that budget)"
+                )
+        if self.canary_autopromote:
+            if not self.canary_load:
+                raise TopologyError(
+                    "serving.canary_autopromote needs serving.canary_load "
+                    "(the candidate checkpoint to canary)"
+                )
+            if self.replicas < 2 or not self.slo_ms:
+                raise TopologyError(
+                    "serving.canary_autopromote runs on the serving ROUTER "
+                    "— it requires serving.replicas >= 2 and "
+                    "serving.slo_ms (the breach budget)"
+                )
+        if bool(self.canary_load) != bool(self.canary_fraction > 0):
+            raise TopologyError(
+                "serving.canary_load and serving.canary_fraction come "
+                "together: the checkpoint names WHAT to canary, the "
+                "fraction names HOW MUCH traffic it gets"
+            )
+        if not 0 <= self.canary_fraction <= 1:
+            raise TopologyError(
+                "serving.canary_fraction must be a traffic fraction in "
+                f"[0, 1], got {self.canary_fraction}"
+            )
+
+    @property
+    def routed(self) -> bool:
+        """True when the plane needs the router (R > 1, or autoscale
+        headroom above a single replica)."""
+        return self.replicas > 1 or bool(
+            self.replicas_max and self.replicas_max > self.replicas
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosTopology:
+    """A seeded ChaosMonkey schedule (orchestrate/chaos.py) — present in
+    the spec so a certification run's kill cadence is part of the
+    document it certifies."""
+
+    seed: int = 0
+    interval_s: float = 5.0
+    jitter_s: float = 0.0
+    max_kills: int = 0  # 0 = unbounded
+    initial_delay_s: float = 0.0
+
+    def __post_init__(self):
+        if self.interval_s <= 0:
+            raise TopologyError("chaos.interval_s must be > 0")
+        if self.max_kills < 0 or self.jitter_s < 0 or self.initial_delay_s < 0:
+            raise TopologyError("chaos bounds must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class NetChaosTopology:
+    """A netchaos FaultSchedule document (netchaos/schedule.py JSON form:
+    per-link faults + partition windows under one seed)."""
+
+    seed: int = 0
+    links: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        # delegate link/partition validation to the schedule itself so the
+        # two JSON forms cannot drift; keep the plain dict for round-trip
+        from distributed_ba3c_tpu.netchaos.schedule import FaultSchedule
+
+        try:
+            FaultSchedule(dict(self.links), seed=self.seed)
+        except (TypeError, ValueError) as e:
+            raise TopologyError(f"bad netchaos schedule: {e}") from None
+        object.__setattr__(
+            self, "links", {str(k): v for k, v in dict(self.links).items()}
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ReconcilePolicy:
+    """How the reconcile loop itself acts: tick cadence, per-resource
+    act backoff, and the topology-wide restart-budget circuit breaker."""
+
+    poll_interval_s: float = 0.25
+    backoff_base_s: float = 0.5
+    backoff_max_s: float = 30.0
+    #: more than this many heal actions inside ``budget_window_s`` opens
+    #: the circuit topology-wide (healing pauses until the window drains
+    #: to half the budget) — a crash loop anywhere must degrade to a
+    #: visible incident, not a fork storm
+    restart_budget: int = 64
+    budget_window_s: float = 300.0
+
+    def __post_init__(self):
+        if self.poll_interval_s <= 0:
+            raise TopologyError("reconcile.poll_interval_s must be > 0")
+        if self.backoff_base_s < 0 or self.backoff_max_s < self.backoff_base_s:
+            raise TopologyError(
+                "need 0 <= reconcile.backoff_base_s <= backoff_max_s, got "
+                f"{self.backoff_base_s}/{self.backoff_max_s}"
+            )
+        if self.restart_budget < 0 or self.budget_window_s <= 0:
+            raise TopologyError(
+                "reconcile.restart_budget must be >= 0 and "
+                "budget_window_s > 0"
+            )
+
+    def backoff_s(self, consecutive_failures: int) -> float:
+        n = max(1, int(consecutive_failures))
+        return min(self.backoff_max_s, self.backoff_base_s * (2 ** (n - 1)))
+
+
+#: the trainer/task/env mode block — the cross-section rules below need it
+@dataclasses.dataclass(frozen=True)
+class ModeTopology:
+    task: str = "train"
+    trainer: str = "tpu_ba3c"
+    env: str = "cpp:pong"
+    overlap: bool = False
+    fleet_accum: int = 1
+    steps_per_epoch: int = 6000
+    steps_per_dispatch: int = 1
+
+    def __post_init__(self):
+        if self.task not in ("train", "eval", "play", "dump_config"):
+            raise TopologyError(f"unknown mode.task {self.task!r}")
+        if self.fleet_accum < 1:
+            raise TopologyError(
+                f"mode.fleet_accum must be >= 1, got {self.fleet_accum}"
+            )
+        if self.steps_per_dispatch < 1 or self.steps_per_epoch < 1:
+            raise TopologyError("mode step counts must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologySpec:
+    """The whole deployment, as one reviewable document.
+
+    ``fleets`` carries one FleetSpec per actor fleet (empty = external
+    fleets, supervised on their own hosts); ``learner``/``pod``/
+    ``serving`` are optional sections (absent = that plane is not part of
+    this topology); ``chaos``/``netchaos`` make a certification run's
+    fault schedule part of the document it certifies; ``reconcile`` is
+    the loop's own policy. JSON round-trips losslessly and every level
+    rejects unknown fields.
+    """
+
+    version: int = 1
+    mode: ModeTopology = dataclasses.field(default_factory=ModeTopology)
+    fleets: Tuple[FleetSpec, ...] = ()
+    learner: Optional[LearnerTopology] = None
+    pod: Optional[PodTopology] = None
+    serving: Optional[ServingTopology] = None
+    chaos: Optional[ChaosTopology] = None
+    netchaos: Optional[NetChaosTopology] = None
+    reconcile: ReconcilePolicy = dataclasses.field(
+        default_factory=ReconcilePolicy
+    )
+
+    def __post_init__(self):
+        if self.version != 1:
+            raise TopologyError(
+                f"unknown topology version {self.version!r} (this tree "
+                "speaks version 1)"
+            )
+        object.__setattr__(self, "fleets", tuple(self.fleets))
+        self._validate_cross_sections()
+
+    # -- the cross-section rules (cli.py's old inline validation) ---------
+    def _validate_cross_sections(self) -> None:
+        m = self.mode
+        n_fleets = len(self.fleets)
+        if m.task == "train" and m.env.startswith("zmq:") and any(
+            not (f.pipe_c2s and f.pipe_s2c) for f in self.fleets
+        ):
+            raise TopologyError(
+                "env zmq: means external env-server fleets feed this "
+                "learner — give them reachable endpoints via "
+                "pipe_c2s/pipe_s2c (e.g. tcp://0.0.0.0:5555 / "
+                "tcp://0.0.0.0:5556)"
+            )
+        if (
+            m.steps_per_dispatch > 1
+            and m.steps_per_epoch % m.steps_per_dispatch
+        ):
+            raise TopologyError(
+                f"steps_per_dispatch {m.steps_per_dispatch} must divide "
+                f"steps_per_epoch {m.steps_per_epoch}"
+            )
+        if m.overlap and m.trainer != "tpu_fused_ba3c":
+            raise TopologyError(
+                "overlap splits the FUSED trainer's program in two — it "
+                "requires trainer tpu_fused_ba3c (the ZMQ trainers "
+                "already overlap actors and learner across processes)"
+            )
+        if n_fleets > 1 and (
+            m.task != "train" or m.trainer == "tpu_fused_ba3c"
+        ):
+            raise TopologyError(
+                "multiple fleets run against the ZMQ-plane trainers' "
+                "train task — the fused trainer has no actor plane (its "
+                "macro-batching knob is fleet_accum with overlap), and "
+                "eval/play spawn no fleet"
+            )
+        if m.fleet_accum > 1 and not m.overlap:
+            raise TopologyError(
+                "fleet_accum accumulates rollout windows in the overlap "
+                "trainer's macro learner — it requires trainer "
+                "tpu_fused_ba3c with overlap (ZMQ-plane macro-batching "
+                "is multiple fleets)"
+            )
+        if self.serving is not None and (
+            m.task != "train" or m.trainer == "tpu_fused_ba3c"
+        ):
+            raise TopologyError(
+                "the serving section configures the predictor serving "
+                "plane — it applies to the ZMQ-plane trainers' train "
+                "task only (the fused trainer serves actions inside its "
+                "compiled program; eval/play are synchronous)"
+            )
+        if (
+            self.serving is not None
+            and self.serving.canary_autopromote
+            and n_fleets > 1
+        ):
+            raise TopologyError(
+                "serving.canary_autopromote decides per router; with "
+                "multiple fleets there are N independent routers and one "
+                "canary decision must not be made N times — run it "
+                "single-fleet"
+            )
+        if n_fleets and m.env.startswith("zmq:") and any(
+            f.fleet_min != f.fleet_size or f.fleet_max != f.fleet_size
+            for f in self.fleets
+        ):
+            raise TopologyError(
+                "fleet_min/fleet_max size a LOCALLY-supervised env fleet "
+                "— external zmq: fleets are supervised on their own "
+                "hosts (scripts/launch_env_fleet.py)"
+            )
+        # a derived-pipe collision is a spec bug, not a runtime surprise
+        pipes = [a for f in self.fleets for a in (f.pipe_c2s, f.pipe_s2c) if a]
+        if len(set(pipes)) != len(pipes):
+            raise TopologyError(
+                f"fleet pipe addresses collide across {n_fleets} fleets: "
+                f"{pipes}"
+            )
+
+    # -- (de)serialization -------------------------------------------------
+    def to_doc(self) -> Dict[str, Any]:
+        doc: Dict[str, Any] = {
+            "version": self.version,
+            "mode": dataclasses.asdict(self.mode),
+            "fleets": [dataclasses.asdict(f) for f in self.fleets],
+            "reconcile": dataclasses.asdict(self.reconcile),
+        }
+        for name in ("learner", "pod", "serving", "chaos", "netchaos"):
+            section = getattr(self, name)
+            if section is not None:
+                doc[name] = dataclasses.asdict(section)
+        return doc
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_doc(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_doc(cls, doc: Any) -> "TopologySpec":
+        if not isinstance(doc, Mapping):
+            raise TopologyError(
+                f"topology spec must be a JSON object, got "
+                f"{type(doc).__name__}"
+            )
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = sorted(set(doc) - known)
+        if unknown:
+            raise TopologyError(f"unknown topology fields: {unknown}")
+        kw: Dict[str, Any] = {}
+        if "version" in doc:
+            kw["version"] = doc["version"]
+        if "mode" in doc:
+            kw["mode"] = _dataclass_from_doc(ModeTopology, doc["mode"], "mode")
+        fleets_doc = doc.get("fleets", [])
+        if not isinstance(fleets_doc, (list, tuple)):
+            raise TopologyError(
+                f"fleets must be a JSON array, got "
+                f"{type(fleets_doc).__name__}"
+            )
+        fleets = []
+        for i, fd in enumerate(fleets_doc):
+            try:
+                fleets.append(
+                    _dataclass_from_doc(FleetSpec, fd, f"fleets[{i}]")
+                )
+            except ValueError as e:  # FleetSpec's own __post_init__ bounds
+                raise TopologyError(str(e)) from None
+        kw["fleets"] = tuple(fleets)
+        for name, section_cls in (
+            ("learner", LearnerTopology),
+            ("pod", PodTopology),
+            ("serving", ServingTopology),
+            ("chaos", ChaosTopology),
+            ("netchaos", NetChaosTopology),
+        ):
+            if doc.get(name) is not None:
+                kw[name] = _dataclass_from_doc(
+                    section_cls, doc[name], name
+                )
+        if "reconcile" in doc:
+            kw["reconcile"] = _dataclass_from_doc(
+                ReconcilePolicy, doc["reconcile"], "reconcile"
+            )
+        try:
+            return cls(**kw)
+        except TopologyError:
+            raise
+        except (TypeError, ValueError) as e:
+            raise TopologyError(f"bad topology spec: {e}") from None
+
+    @classmethod
+    def from_json(cls, text: str) -> "TopologySpec":
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise TopologyError(f"topology spec is not valid JSON: {e}")
+        return cls.from_doc(doc)
+
+    @classmethod
+    def load(cls, path: str) -> "TopologySpec":
+        try:
+            with open(path) as fh:
+                text = fh.read()
+        except OSError as e:
+            raise TopologyError(f"cannot read topology spec: {e}")
+        return cls.from_json(text)
+
+    # -- flags -> spec (the cli.py migration path) -------------------------
+    @classmethod
+    def from_flags(cls, args) -> "TopologySpec":
+        """Build the spec a cli.py flag set describes (``--dump_topology``
+        emits exactly this). Raises TopologyError for every combo the old
+        inline validation block rejected — the rules live HERE now."""
+        if getattr(args, "fleets", 1) < 1:
+            raise TopologyError(f"--fleets must be >= 1, got {args.fleets}")
+        if args.fleets > 1 and (
+            args.task != "train" or args.trainer == "tpu_fused_ba3c"
+        ):
+            raise TopologyError(
+                "--fleets N runs N actor fleets against the ZMQ-plane "
+                "trainers' train task — the fused trainer has no actor "
+                "plane (its macro-batching knob is --fleet_accum with "
+                "--overlap), and eval/play spawn no fleet"
+            )
+        if getattr(args, "serve_replicas", 1) < 1:
+            raise TopologyError(
+                f"--serve_replicas must be >= 1, got {args.serve_replicas}"
+            )
+        if bool(args.pipe_c2s) != bool(args.pipe_s2c):
+            raise TopologyError(
+                "--pipe_c2s and --pipe_s2c must be given together"
+            )
+        if (
+            args.task == "train"
+            and args.env.startswith("zmq:")
+            and not (args.pipe_c2s and args.pipe_s2c)
+        ):
+            raise TopologyError(
+                "--env zmq: means external env-server fleets feed this "
+                "learner — give them reachable endpoints via --pipe_c2s/"
+                "--pipe_s2c (e.g. tcp://0.0.0.0:5555 / tcp://0.0.0.0:5556)"
+            )
+        mode = ModeTopology(
+            task=args.task,
+            trainer=args.trainer,
+            env=args.env,
+            overlap=bool(getattr(args, "overlap", False)),
+            fleet_accum=getattr(args, "fleet_accum", 1),
+            steps_per_epoch=args.steps_per_epoch,
+            steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
+        )
+        fleets: Tuple[FleetSpec, ...] = ()
+        external = args.env.startswith("zmq:")
+        spawns_fleet = args.task == "train" and mode.trainer != "tpu_fused_ba3c"
+        if (args.fleet_min or args.fleet_max) and (
+            args.task != "train" or external
+        ):
+            raise TopologyError(
+                "--fleet_min/--fleet_max size a LOCALLY-supervised env "
+                "fleet — external zmq: fleets are supervised on their own "
+                "hosts (scripts/launch_env_fleet.py), and eval/play spawn "
+                "no fleet"
+            )
+        if (
+            args.fleet_min
+            and args.fleet_max
+            and args.fleet_min > args.fleet_max
+        ):
+            raise TopologyError(
+                f"--fleet_min {args.fleet_min} > --fleet_max "
+                f"{args.fleet_max}"
+            )
+        if spawns_fleet:
+            from distributed_ba3c_tpu.actors.fleet import fleet_pipes
+
+            n_fleets = args.fleets
+            c2s = args.pipe_c2s or "ipc://ba3c-c2s"
+            s2c = args.pipe_s2c or "ipc://ba3c-s2c"
+            sims = args.simulator_procs or 50
+            per_fleet = max(1, sims // n_fleets)
+            if external:
+                per, wire, n_servers = 16, "block", per_fleet
+            elif args.env.startswith("cpp:"):
+                per = min(16, per_fleet)
+                wire = args.wire if args.wire != "auto" else "block"
+                n_servers = (per_fleet + per - 1) // per
+            else:
+                per, wire, n_servers = 1, "per-env", per_fleet
+            lo = args.fleet_min or n_servers
+            hi = args.fleet_max or n_servers
+            if not lo <= n_servers <= hi:
+                raise TopologyError(
+                    f"launch fleet size {n_servers} servers is outside "
+                    f"[--fleet_min {lo}, --fleet_max {hi}] — size the "
+                    "launch fleet (--simulator_procs, split per fleet) "
+                    "inside the bounds"
+                )
+            game = (
+                args.env.split(":", 1)[1]
+                if args.env.startswith("cpp:")
+                else "pong"
+            )
+            built = []
+            for k in range(n_fleets):
+                c2s_k, s2c_k = fleet_pipes(c2s, s2c, k)
+                try:
+                    built.append(FleetSpec(
+                        pipe_c2s=c2s_k, pipe_s2c=s2c_k, game=game,
+                        envs_per_server=per, wire=wire,
+                        fleet_size=n_servers, fleet_min=min(lo, n_servers),
+                        fleet_max=max(hi, n_servers),
+                    ))
+                except ValueError as e:
+                    raise TopologyError(str(e)) from None
+            fleets = tuple(built)
+        serving = None
+        if (
+            args.serve_slo_ms or args.canary_load or args.shadow_load
+            or args.canary_fraction > 0
+            or args.serve_replicas > 1 or args.serve_replicas_max
+        ):
+            serving = ServingTopology(
+                replicas=args.serve_replicas,
+                replicas_max=args.serve_replicas_max or 0,
+                slo_ms=args.serve_slo_ms or 0.0,
+                canary_load=args.canary_load or "",
+                canary_fraction=args.canary_fraction,
+                canary_autopromote=bool(args.canary_autopromote),
+                shadow_load=args.shadow_load or "",
+                autoscale_interval_s=args.autoscale_interval,
+            )
+        learner = None
+        if args.task == "train" and args.logdir:
+            learner = LearnerTopology(
+                logdir=args.logdir,
+                train_args=("--logdir", args.logdir),
+            )
+        return cls(
+            mode=mode, fleets=fleets, learner=learner, serving=serving,
+        )

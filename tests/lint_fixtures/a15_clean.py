@@ -1,0 +1,33 @@
+"""A15 clean fixture: poll-only, spawn-only, and sanctioned loop shapes."""
+import time
+
+
+def wait_for_exit(child, timeout_s):
+    # poll-only loop: observing liveness without respawning is a plain
+    # wait, not a shadow supervisor
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if child.poll() is not None:
+            return child.returncode
+        time.sleep(0.1)
+    return None
+
+
+def launch_fan_out(factories):
+    # spawn-only loop: a launch fan-out never observes liveness, so it
+    # cannot be a supervision cycle
+    workers = [f() for f in factories]
+    for w in workers:
+        w.start()
+    return workers
+
+
+def sanctioned_bench_loop(worker_factory, reps):
+    # an acceptance bench that IS the measurand of supervision carries
+    # the sanction
+    worker = worker_factory()
+    worker.start()
+    for _ in range(reps):  # ba3clint: disable=A15 — bench measures respawn latency; the reconciler under test budgets the heals
+        if not worker.is_alive():
+            worker = worker_factory()
+            worker.start()

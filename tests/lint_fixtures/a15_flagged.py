@@ -1,0 +1,24 @@
+"""A15 flagged fixture: hand-rolled supervision loops outside orchestrate/."""
+import subprocess
+import time
+
+
+def shadow_supervisor(worker_factory):
+    # the closed observe+respawn cycle: polls liveness AND restarts in
+    # the same loop — unbudgeted, uncounted, no decision trail
+    worker = worker_factory()
+    worker.start()
+    while True:
+        if not worker.is_alive():
+            worker = worker_factory()
+            worker.start()
+        time.sleep(0.5)
+
+
+def child_babysitter(argv, n):
+    # subprocess flavor: .poll() liveness + fresh Popen respawn
+    child = subprocess.Popen(argv)
+    for _ in range(n):
+        if child.poll() is not None:
+            child = subprocess.Popen(argv)
+        time.sleep(1)

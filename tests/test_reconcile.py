@@ -1,0 +1,616 @@
+"""The reconcile loop (orchestrate/reconcile.py, docs/topology.md).
+
+Three layers, matching the module's own split:
+
+- the PURE diff functions — a deterministic unit suite: snapshot in,
+  exact action list out, no fakes needed;
+- the resource-kind matrix driven through ``Reconciler.tick_once()``
+  with fake controllers: every kind × {kill, wedge, scale up, scale
+  down, failed act retried next tick};
+- the loop's own machinery: per-resource exponential backoff parks, the
+  topology-wide restart-budget circuit breaker (open → half-open drain →
+  closed), flight-recorded decisions carrying their input snapshot.
+"""
+
+import time
+
+import pytest
+
+from distributed_ba3c_tpu import telemetry
+from distributed_ba3c_tpu.orchestrate.reconcile import (
+    Action,
+    FleetResource,
+    LearnerResource,
+    PolicyResource,
+    Reconcilable,
+    Reconciler,
+    ServingResource,
+    diff_fleet,
+    diff_learner,
+    diff_serving,
+)
+from distributed_ba3c_tpu.orchestrate.topology import ReconcilePolicy
+
+
+def verbs(actions):
+    return [a.verb for a in actions]
+
+
+# --------------------------------------------------------------------------
+# the deterministic diff unit suite
+# --------------------------------------------------------------------------
+
+
+class TestDiffFleet:
+    def test_steady_state_is_empty(self):
+        assert diff_fleet("f", {"target": 4, "live": 4}) == []
+
+    def test_wedged_slots_die_first(self):
+        acts = diff_fleet("f", {
+            "wedged": ("env-srv-1", "env-srv-3"),
+            "vacant_due": (2,),
+            "ever_started": True,
+        })
+        assert verbs(acts) == ["kill", "kill", "respawn"]
+        assert acts[0].detail_dict()["ident"] == "env-srv-1"
+
+    def test_vacancy_respawns_after_first_start(self):
+        acts = diff_fleet("f", {"vacant_due": (0, 1), "ever_started": True})
+        assert verbs(acts) == ["respawn", "respawn"]
+        assert [a.detail_dict()["slot"] for a in acts] == [0, 1]
+
+    def test_never_started_fleet_spawns(self):
+        acts = diff_fleet("f", {"vacant_due": (0,), "ever_started": False})
+        assert verbs(acts) == ["spawn"]
+
+    def test_supervisor_circuit_open_parks_all_but_kills(self):
+        acts = diff_fleet("f", {
+            "wedged": ("w",), "vacant_due": (0,), "circuit_open": True,
+            "scale_delta": 2,
+        })
+        assert verbs(acts) == ["kill"]
+
+    def test_scale_intent_becomes_scale_action(self):
+        acts = diff_fleet("f", {
+            "scale_delta": -2, "scale_reason": "queue drained",
+        })
+        assert verbs(acts) == ["scale"]
+        assert acts[0].detail_dict()["delta"] == -2
+        assert acts[0].reason == "queue drained"
+
+    def test_backoff_parked_vacancy_is_drift_not_action(self):
+        # vacant slots still inside their spawn backoff are NOT due
+        assert diff_fleet("f", {"vacant_backoff": (1,)}) == []
+
+
+class TestDiffLearner:
+    def test_terminal_states_want_nothing(self):
+        assert diff_learner("l", {"done": True}) == []
+        assert diff_learner("l", {"given_up": True, "running": False}) == []
+
+    def test_healthy_run_wants_nothing(self):
+        assert diff_learner("l", {"running": True, "stalled": False}) == []
+
+    def test_stall_kills(self):
+        acts = diff_learner("l", {
+            "running": True, "stalled": True, "attempt": 2,
+        })
+        assert verbs(acts) == ["kill"]
+        assert acts[0].detail_dict()["attempt"] == 2
+
+    def test_dead_learner_rearms_through_resume_gate(self):
+        acts = diff_learner("l", {"running": False, "finalized_step": 600})
+        assert verbs(acts) == ["re-arm"]
+        assert "finalized checkpoint" in acts[0].reason
+        assert acts[0].detail_dict()["resume_step"] == 600
+
+    def test_no_checkpoint_rearms_from_scratch(self):
+        acts = diff_learner("l", {"running": False, "finalized_step": None})
+        assert verbs(acts) == ["re-arm"]
+        assert "scratch" in acts[0].reason
+
+
+class TestDiffServing:
+    def test_steady_state_is_empty(self):
+        assert diff_serving("s", {"target": 2, "min_replicas": 2}) == []
+
+    def test_dead_replicas_replaced_one_to_one(self):
+        acts = diff_serving("s", {
+            "target": 2, "min_replicas": 2, "dead": ("r0", "r1"),
+        })
+        assert verbs(acts) == ["replace", "replace"]
+
+    def test_shortfall_grows_back_to_floor(self):
+        acts = diff_serving("s", {"target": 1, "min_replicas": 3})
+        assert verbs(acts) == ["spawn"]
+        assert acts[0].detail_dict()["n"] == 2
+
+    def test_dead_suppresses_the_spawn_path(self):
+        # the replace act heals-to-count already; a second grow action
+        # the same round would double-spawn
+        acts = diff_serving("s", {
+            "target": 1, "min_replicas": 2, "dead": ("r0",),
+        })
+        assert verbs(acts) == ["replace"]
+
+
+def test_action_detail_round_trip_and_hashable():
+    a = Action.make("scale", "fleet0", reason="why", delta=2, slot=1)
+    assert a.detail_dict() == {"delta": 2, "slot": 1}
+    assert hash(a) == hash(Action.make("scale", "fleet0", reason="why",
+                                       slot=1, delta=2))
+
+
+# --------------------------------------------------------------------------
+# fakes: scripted controllers under the real adapters / loop
+# --------------------------------------------------------------------------
+
+
+class FakeFleetSup:
+    """Scripted FleetSupervisor surface: observe() returns whatever the
+    test staged, act calls are counted."""
+
+    def __init__(self, obs=None):
+        self.obs = dict(obs or {})
+        self.spawned = False
+        self.ticks = 0
+        self.scales = []
+        self.closed = False
+
+    def spawn_initial(self):
+        self.spawned = True
+
+    def observe(self):
+        return dict(self.obs)
+
+    def tick(self):
+        self.ticks += 1
+
+    def scale_by(self, delta, reason=""):
+        self.scales.append((delta, reason))
+
+    def close(self):
+        self.closed = True
+
+
+class FakeLearnerSup:
+    def __init__(self):
+        self.attempt = 0
+        self.running = False
+        self.stalled = False
+        self.ckpt_dir = "/nonexistent-ckpt-dir"
+        self.pending_rc = None
+        self.verdict = "retry"
+        self.starts = 0
+        self.kills = 0
+        self.terminated = False
+
+    def attempt_running(self):
+        return self.running
+
+    def attempt_stalled(self):
+        return self.stalled
+
+    def kill_attempt(self, reason=""):
+        self.kills += 1
+        self.running = False
+
+    def reap_attempt(self):
+        rc, self.pending_rc = self.pending_rc, None
+        return rc
+
+    def note_exit(self, rc):
+        return self.verdict
+
+    def start_attempt(self):
+        self.starts += 1
+        self.running = True
+
+    def terminate_attempt(self):
+        self.terminated = True
+
+
+class FakeRouter:
+    def __init__(self):
+        self.states = {}
+
+    def replica_states(self):
+        return dict(self.states)
+
+
+class FakeReplicaSet:
+    def __init__(self, live, min_replicas=1, max_replicas=4):
+        self.router = FakeRouter()
+        self.live = list(live)
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.reconciles = 0
+        self.scale_calls = []
+
+    def replica_ids(self):
+        return list(self.live)
+
+    def reconcile(self):
+        self.reconciles += 1
+        # heal-to-count: dead incarnations replaced
+        self.router.states = {r: "ready" for r in self.live}
+
+    def scale_to(self, n, reason=""):
+        self.scale_calls.append((n, reason))
+        self.live = [f"r{i}" for i in range(n)]
+
+
+class FakeController:
+    def __init__(self):
+        self.ticks = 0
+        self.stopped = False
+
+    def tick(self):
+        self.ticks += 1
+
+    def stop(self):
+        self.stopped = True
+
+
+class FlakyResource(Reconcilable):
+    """Always wants one heal; act fails the first ``fail_n`` times."""
+
+    kind = "fleet"
+
+    def __init__(self, name, fail_n=0):
+        self.name = name
+        self.fail_n = fail_n
+        self.acts = 0
+
+    def observe(self):
+        return {"kind": "fleet"}
+
+    def diff(self, observed):
+        return [Action.make("respawn", self.name, reason="always vacant")]
+
+    def act(self, action):
+        self.acts += 1
+        if self.acts <= self.fail_n:
+            raise RuntimeError(f"respawn attempt {self.acts} failed")
+
+
+def quiet_policy(**kw):
+    kw.setdefault("poll_interval_s", 0.01)
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("backoff_max_s", max(30.0, kw["backoff_base_s"]))
+    return ReconcilePolicy(**kw)
+
+
+# --------------------------------------------------------------------------
+# the resource-kind matrix, through the real loop
+# --------------------------------------------------------------------------
+
+
+class TestFleetMatrix:
+    def test_kill_and_respawn_ride_one_supervisor_tick(self):
+        sup = FakeFleetSup({
+            "wedged": ("w-1",), "vacant_due": (0, 1), "ever_started": True,
+        })
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(FleetResource("fleet0", sup))
+        executed = rec.tick_once()
+        assert verbs(executed) == ["kill", "respawn", "respawn"]
+        # one underlying slot pass heals the whole round
+        assert sup.ticks == 1
+
+    def test_scale_up_and_down_through_scale_intent(self):
+        sup = FakeFleetSup()
+        intents = [(2, "queue deep"), (0, ""), (-1, "queue drained")]
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(FleetResource("fleet0", sup, scale_intent=intents.pop))
+        # intents pop from the tail: -1 first, then 0 (no action), then +2
+        assert verbs(rec.tick_once()) == ["scale"]
+        assert verbs(rec.tick_once()) == []
+        assert verbs(rec.tick_once()) == ["scale"]
+        assert sup.scales == [(-1, "queue drained"), (2, "queue deep")]
+
+    def test_failed_respawn_retried_next_tick(self):
+        res = FlakyResource("fleet0", fail_n=1)
+        rec = Reconciler(policy=quiet_policy())  # backoff base 0: due at once
+        rec.add(res)
+        assert rec.tick_once() == []  # act raised: nothing executed
+        assert verbs(rec.tick_once()) == ["respawn"]  # retried and healed
+        assert res.acts == 2
+
+    def test_backoff_parks_a_failing_resource(self):
+        res = FlakyResource("fleet0", fail_n=100)
+        rec = Reconciler(policy=quiet_policy(backoff_base_s=60.0))
+        rec.add(res)
+        rec.tick_once()
+        assert res.acts == 1
+        rec.tick_once()  # parked: 60s backoff has not elapsed
+        assert res.acts == 1
+        skipped = telemetry.registry("reconciler").counter(
+            "reconcile_skipped_total"
+        )
+        assert skipped.value() >= 1
+
+    def test_retire_closes_the_supervisor(self):
+        sup = FakeFleetSup()
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(FleetResource("fleet0", sup))
+        rec.close()  # never started: close still retires
+        assert sup.closed
+
+    def test_pod_kind_buckets_the_pod_heal_counter(self):
+        sup = FakeFleetSup({"vacant_due": (0,), "ever_started": True})
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(FleetResource("pod-hosts", sup, kind="pod"))
+        before = telemetry.registry("reconciler").counter(
+            "reconcile_heal_pod_total"
+        ).value()
+        rec.tick_once()
+        after = telemetry.registry("reconciler").counter(
+            "reconcile_heal_pod_total"
+        ).value()
+        assert after == before + 1
+
+
+class TestLearnerMatrix:
+    def test_dead_learner_rearmed_and_accounted(self):
+        sup = FakeLearnerSup()
+        sup.pending_rc = 1  # previous attempt died
+        res = LearnerResource("learner", sup)
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(res)
+        assert verbs(rec.tick_once()) == ["re-arm"]
+        assert sup.starts == 1 and sup.running
+        assert res.final_rc is None
+
+    def test_stalled_learner_killed_then_rearmed(self):
+        sup = FakeLearnerSup()
+        sup.running = True
+        sup.stalled = True
+        res = LearnerResource("learner", sup)
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(res)
+        assert verbs(rec.tick_once()) == ["kill"]
+        assert sup.kills == 1 and not sup.running
+        sup.pending_rc = 1
+        assert verbs(rec.tick_once()) == ["re-arm"]
+        assert sup.starts == 1
+
+    def test_clean_exit_finishes_supervision(self):
+        sup = FakeLearnerSup()
+        sup.pending_rc = 0
+        sup.verdict = "done"
+        res = LearnerResource("learner", sup)
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(res)
+        rec.tick_once()
+        assert res.final_rc == 0
+        assert sup.starts == 0  # done: no relaunch
+        assert rec.tick_once() == []  # terminal state wants nothing
+
+    def test_budget_exhaustion_gives_up_with_the_fatal_rc(self):
+        sup = FakeLearnerSup()
+        sup.pending_rc = 9
+        sup.verdict = "giveup"
+        res = LearnerResource("learner", sup)
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(res)
+        rec.tick_once()
+        assert res.final_rc == 9
+        assert sup.starts == 0
+        assert rec.tick_once() == []
+
+
+class TestServingMatrix:
+    def test_dead_replica_heals_through_reconcile(self):
+        rs = FakeReplicaSet(["r0", "r1"], min_replicas=2)
+        rs.router.states = {"r0": "ready", "r1": "dead"}
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(ServingResource("serving", rs))
+        assert verbs(rec.tick_once()) == ["replace"]
+        assert rs.reconciles == 1
+        assert rec.tick_once() == []  # healed: steady state
+
+    def test_scale_up_to_floor(self):
+        rs = FakeReplicaSet(["r0"], min_replicas=3)
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(ServingResource("serving", rs))
+        acts = rec.tick_once()
+        assert verbs(acts) == ["spawn"]
+        assert rs.scale_calls == [(3, "replica set below floor")]
+
+    def test_two_dead_replicas_one_underlying_heal(self):
+        rs = FakeReplicaSet(["r0", "r1", "r2"], min_replicas=3)
+        rs.router.states = {"r0": "dead", "r1": "dead", "r2": "ready"}
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(ServingResource("serving", rs))
+        assert verbs(rec.tick_once()) == ["replace", "replace"]
+        assert rs.reconciles == 1  # heal-to-count is atomic per round
+
+
+class TestPolicyResource:
+    def test_interval_gates_the_tick(self):
+        ctrl = FakeController()
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(PolicyResource("autoscaler", ctrl, interval_s=3600))
+        rec.tick_once()
+        assert ctrl.ticks == 1  # first tick is due immediately
+        rec.tick_once()
+        assert ctrl.ticks == 1  # interval has not elapsed
+
+    def test_zero_interval_ticks_every_round(self):
+        ctrl = FakeController()
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(PolicyResource("autoscaler", ctrl, interval_s=0))
+        rec.tick_once()
+        rec.tick_once()
+        assert ctrl.ticks == 2
+
+    def test_policy_ticks_do_not_burn_restart_budget(self):
+        ctrl = FakeController()
+        rec = Reconciler(policy=quiet_policy(restart_budget=1))
+        rec.add(PolicyResource("autoscaler", ctrl, interval_s=0))
+        for _ in range(5):
+            rec.tick_once()
+        assert ctrl.ticks == 5
+        assert not rec.circuit_open
+
+    def test_retire_stops_the_controller(self):
+        ctrl = FakeController()
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(PolicyResource("autoscaler", ctrl))
+        rec.close()
+        assert ctrl.stopped
+
+
+# --------------------------------------------------------------------------
+# loop machinery: assembly, circuit breaker, flight trail
+# --------------------------------------------------------------------------
+
+
+class TestAssembly:
+    def test_duplicate_names_rejected(self):
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(FleetResource("fleet0", FakeFleetSup()))
+        with pytest.raises(ValueError, match="duplicate"):
+            rec.add(FleetResource("fleet0", FakeFleetSup()))
+
+    def test_nameless_resource_rejected(self):
+        with pytest.raises(ValueError, match="name"):
+            Reconciler(policy=quiet_policy()).add(
+                FleetResource("", FakeFleetSup())
+            )
+
+    def test_observe_diff_error_skips_resource_not_tick(self):
+        class Broken(Reconcilable):
+            kind, name = "fleet", "broken"
+
+            def observe(self):
+                raise RuntimeError("observation source gone")
+
+        sup = FakeFleetSup({"vacant_due": (0,), "ever_started": True})
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(Broken())
+        rec.add(FleetResource("fleet0", sup))
+        # the healthy resource still heals in the same tick
+        assert verbs(rec.tick_once()) == ["respawn"]
+
+
+class TestCircuitBreaker:
+    def test_opens_past_budget_and_halts_healing(self):
+        res = FlakyResource("fleet0")
+        rec = Reconciler(policy=quiet_policy(
+            restart_budget=2, budget_window_s=300,
+        ))
+        rec.add(res)
+        for _ in range(3):
+            rec.tick_once()
+        assert rec.circuit_open  # 3 heals > budget 2
+        assert rec.tick_once() == []  # healing paused
+        assert res.acts == 3
+
+    def test_half_open_drain_closes(self):
+        res = FlakyResource("fleet0")
+        rec = Reconciler(policy=quiet_policy(
+            restart_budget=2, budget_window_s=300,
+        ))
+        rec.add(res)
+        for _ in range(3):
+            rec.tick_once()
+        assert rec.circuit_open
+        # drain the window below half the budget (as time passing would)
+        while len(rec._heal_times) > 1:
+            rec._heal_times.popleft()
+        rec.tick_once()  # this tick still skips, then re-evaluates
+        assert not rec.circuit_open
+        assert verbs(rec.tick_once()) == ["respawn"]
+
+    def test_window_expiry_drains_naturally(self):
+        res = FlakyResource("fleet0")
+        rec = Reconciler(policy=quiet_policy(
+            restart_budget=2, budget_window_s=0.05,
+        ))
+        rec.add(res)
+        for _ in range(3):
+            rec.tick_once()
+        assert rec.circuit_open
+        time.sleep(0.06)
+        rec.tick_once()
+        assert not rec.circuit_open
+
+    def test_zero_budget_is_permanently_open(self):
+        res = FlakyResource("fleet0")
+        rec = Reconciler(policy=quiet_policy(restart_budget=0))
+        rec.add(res)
+        assert rec.circuit_open
+        for _ in range(3):
+            assert rec.tick_once() == []
+        assert res.acts == 0
+        assert rec.circuit_open
+
+    def test_trip_is_flight_recorded(self):
+        t0 = time.monotonic()
+        res = FlakyResource("fleet0")
+        rec = Reconciler(policy=quiet_policy(restart_budget=1))
+        rec.add(res)
+        for _ in range(2):
+            rec.tick_once()
+        events = telemetry.flight_recorder().events_since(
+            t0, kind="reconcile_circuit_open"
+        )
+        assert events and events[-1][2]["budget"] == 1
+
+
+class TestFlightTrail:
+    def test_decision_carries_its_input_snapshot(self):
+        t0 = time.monotonic()
+        sup = FakeFleetSup({"vacant_due": (3,), "ever_started": True})
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(FleetResource("fleet0", sup))
+        rec.tick_once()
+        events = telemetry.flight_recorder().events_since(
+            t0, kind="reconcile_action"
+        )
+        assert events
+        fields = events[-1][2]
+        assert fields["resource"] == "fleet0"
+        assert fields["verb"] == "respawn"
+        assert tuple(fields["snapshot"]["vacant_due"]) == (3,)
+
+    def test_act_failure_is_flight_recorded(self):
+        t0 = time.monotonic()
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(FlakyResource("fleet0", fail_n=1))
+        rec.tick_once()
+        events = telemetry.flight_recorder().events_since(
+            t0, kind="reconcile_act_error"
+        )
+        assert events and events[-1][2]["failures"] == 1
+
+    def test_drift_gauge_tracks_pending_heals(self):
+        sup = FakeFleetSup({
+            "vacant_due": (0, 1), "ever_started": True,
+        })
+        rec = Reconciler(policy=quiet_policy())
+        rec.add(FleetResource("fleet0", sup))
+        rec.tick_once()
+        g = telemetry.registry("reconciler").gauge("reconcile_drift_gauge")
+        assert g.collect()["value"] == 2
+        sup.obs = {}
+        rec.tick_once()
+        assert g.collect()["value"] == 0
+
+
+def test_reconciler_thread_lifecycle_heals_live():
+    """start/stop/close as cli.py's StartProcOrThread drives it: the
+    thread heals without manual ticking."""
+    sup = FakeFleetSup({"vacant_due": (0,), "ever_started": True})
+    rec = Reconciler(policy=quiet_policy())
+    rec.add(FleetResource("fleet0", sup))
+    rec.start()
+    assert sup.spawned  # prepare ran before the loop
+    deadline = time.monotonic() + 5
+    while sup.ticks == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    rec.close()
+    assert sup.ticks >= 1
+    assert sup.closed

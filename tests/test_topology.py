@@ -1,0 +1,400 @@
+"""TopologySpec (orchestrate/topology.py, docs/topology.md).
+
+Four contracts:
+
+- lossless JSON round-trip of a fully-populated spec (every section);
+- the ``--dump_topology`` migration path: cli.py's flag set and the
+  emitted document describe the SAME spec;
+- validation: unknown fields at every nesting level, half-specified
+  combos (the rules cli.py used to police inline live in the spec now),
+  bad bounds — each one a TopologyError, which both entry points turn
+  into a clean exit-2 usage error;
+- the fuzz gate: junk, truncated and type-confused JSON NEVER escapes as
+  a raw traceback.
+"""
+
+import json
+import random
+
+import pytest
+
+from distributed_ba3c_tpu.orchestrate.spec import FleetSpec
+from distributed_ba3c_tpu.orchestrate.topology import (
+    ChaosTopology,
+    LearnerTopology,
+    ModeTopology,
+    NetChaosTopology,
+    PodTopology,
+    ReconcilePolicy,
+    ServingTopology,
+    TopologyError,
+    TopologySpec,
+)
+
+
+def full_spec() -> TopologySpec:
+    """Every section populated — the round-trip worst case."""
+    return TopologySpec(
+        mode=ModeTopology(
+            task="train", trainer="tpu_sync_ba3c", env="cpp:breakout",
+            steps_per_epoch=120, steps_per_dispatch=4,
+        ),
+        fleets=(
+            FleetSpec(
+                pipe_c2s="ipc://t-c2s-0", pipe_s2c="ipc://t-s2c-0",
+                game="breakout", envs_per_server=8, fleet_size=3,
+                fleet_min=2, fleet_max=6,
+            ),
+            FleetSpec(
+                pipe_c2s="ipc://t-c2s-1", pipe_s2c="ipc://t-s2c-1",
+                game="breakout", envs_per_server=8, fleet_size=3,
+                fleet_min=2, fleet_max=6,
+            ),
+        ),
+        learner=LearnerTopology(
+            logdir="/tmp/topo-test", train_args=("--logdir", "/tmp/topo-test"),
+            max_restarts=3, stall_secs=120,
+        ),
+        pod=PodTopology(
+            hosts=2, sims_per_host=4, pipe_c2s="tcp://127.0.0.1:15555",
+            pipe_s2c="tcp://127.0.0.1:15556", max_staleness=4,
+        ),
+        serving=ServingTopology(
+            replicas=2, replicas_max=4, slo_ms=50,
+            canary_load="/ckpt/cand", canary_fraction=0.1,
+        ),
+        chaos=ChaosTopology(seed=7, interval_s=2.5, max_kills=6),
+        netchaos=NetChaosTopology(seed=11, links={
+            "pod": {"partitions": [{"start_s": 1.0, "end_s": 3.0}]},
+        }),
+        reconcile=ReconcilePolicy(poll_interval_s=0.1, restart_budget=32),
+    )
+
+
+# --------------------------------------------------------------------------
+# round-trip
+# --------------------------------------------------------------------------
+
+
+def test_full_round_trip_is_lossless():
+    spec = full_spec()
+    again = TopologySpec.from_json(spec.to_json())
+    assert again == spec
+    # and the re-emitted document is byte-identical (sorted, stable)
+    assert again.to_json() == spec.to_json()
+
+
+def test_minimal_round_trip():
+    spec = TopologySpec()
+    again = TopologySpec.from_json(spec.to_json())
+    assert again == spec
+    assert again.learner is None and again.pod is None
+
+
+def test_load_reads_a_file(tmp_path):
+    p = tmp_path / "spec.json"
+    p.write_text(full_spec().to_json())
+    assert TopologySpec.load(str(p)) == full_spec()
+
+
+def test_load_missing_file_is_a_usage_error(tmp_path):
+    with pytest.raises(TopologyError, match="cannot read"):
+        TopologySpec.load(str(tmp_path / "nope.json"))
+
+
+# --------------------------------------------------------------------------
+# the --dump_topology migration path
+# --------------------------------------------------------------------------
+
+
+def test_dump_topology_round_trips_through_cli(tmp_path, capsys):
+    from distributed_ba3c_tpu.cli import main
+
+    logdir = str(tmp_path / "run")
+    rc = main([
+        "--env", "fake", "--simulator_procs", "4", "--logdir", logdir,
+        "--dump_topology",
+    ])
+    assert rc == 0
+    emitted = TopologySpec.from_json(capsys.readouterr().out)
+    # the document IS the flag set: fake env → per-env wire, one server
+    # per simulator; the learner section carries the supervised logdir
+    assert emitted.mode.env == "fake"
+    assert len(emitted.fleets) == 1
+    assert emitted.fleets[0].wire == "per-env"
+    assert emitted.fleets[0].fleet_size == 4
+    assert emitted.learner is not None
+    assert emitted.learner.logdir == logdir
+    # and the emitted JSON re-parses to the same spec (the pin)
+    assert TopologySpec.from_json(emitted.to_json()) == emitted
+
+
+def test_dump_topology_multi_fleet_derives_distinct_pipes(capsys):
+    from distributed_ba3c_tpu.cli import main
+
+    rc = main([
+        "--env", "zmq:pong", "--fleets", "2",
+        "--pipe_c2s", "tcp://0.0.0.0:5555",
+        "--pipe_s2c", "tcp://0.0.0.0:5556",
+        "--dump_topology",
+    ])
+    assert rc == 0
+    spec = TopologySpec.from_json(capsys.readouterr().out)
+    pipes = [a for f in spec.fleets for a in (f.pipe_c2s, f.pipe_s2c)]
+    assert len(set(pipes)) == 4  # fleet_pipes derived, no collisions
+
+
+# --------------------------------------------------------------------------
+# validation: unknown fields, moved cli rules, bad bounds
+# --------------------------------------------------------------------------
+
+
+def test_unknown_top_level_field_rejected():
+    with pytest.raises(TopologyError, match="unknown topology fields"):
+        TopologySpec.from_doc({"bogus": 1})
+
+
+@pytest.mark.parametrize("section", [
+    "mode", "learner", "pod", "serving", "chaos", "netchaos", "reconcile",
+])
+def test_unknown_nested_field_rejected_at_every_level(section):
+    doc = json.loads(full_spec().to_json())
+    doc[section]["typoed_knob"] = 1
+    with pytest.raises(TopologyError, match=f"unknown {section} fields"):
+        TopologySpec.from_doc(doc)
+
+
+def test_unknown_fleet_field_rejected():
+    doc = json.loads(full_spec().to_json())
+    doc["fleets"][1]["typoed_knob"] = 1
+    with pytest.raises(TopologyError, match=r"unknown fleets\[1\] fields"):
+        TopologySpec.from_doc(doc)
+
+
+def test_unknown_version_rejected():
+    with pytest.raises(TopologyError, match="version"):
+        TopologySpec.from_doc({"version": 2})
+
+
+@pytest.mark.parametrize("mutate, msg", [
+    # the rules cli.py used to police inline — they live in the spec now
+    (lambda d: d["mode"].update(trainer="tpu_fused_ba3c"),
+     "multiple fleets"),
+    (lambda d: d["mode"].update(task="eval"), "multiple fleets"),
+    (lambda d: d["mode"].update(overlap=True), "overlap"),
+    (lambda d: d["mode"].update(fleet_accum=2), "fleet_accum"),
+    (lambda d: d["mode"].update(steps_per_dispatch=7), "must divide"),
+    (lambda d: d["serving"].update(canary_autopromote=True),
+     "canary decision must not be made N times"),
+    (lambda d: d["fleets"][1].update(pipe_c2s="ipc://t-c2s-0"),
+     "collide"),
+])
+def test_cross_section_rules(mutate, msg):
+    doc = json.loads(full_spec().to_json())
+    mutate(doc)
+    with pytest.raises(TopologyError, match=msg):
+        TopologySpec.from_doc(doc)
+
+
+def test_serving_section_rejected_on_fused_trainer():
+    doc = json.loads(full_spec().to_json())
+    doc["fleets"] = []
+    doc["mode"].update(trainer="tpu_fused_ba3c")
+    with pytest.raises(TopologyError, match="serving section"):
+        TopologySpec.from_doc(doc)
+
+
+def test_external_zmq_fleet_needs_endpoints():
+    with pytest.raises(TopologyError, match="reachable endpoints"):
+        TopologySpec(
+            mode=ModeTopology(env="zmq:pong"),
+            fleets=(FleetSpec(pipe_c2s="", pipe_s2c=""),),
+        )
+
+
+@pytest.mark.parametrize("section_cls, kw, msg", [
+    (LearnerTopology, {"logdir": ""}, "logdir"),
+    (LearnerTopology, {"logdir": "x", "max_restarts": -1}, "max_restarts"),
+    (PodTopology, {"hosts": 0}, "hosts"),
+    (PodTopology, {"max_staleness": -2}, "version lag"),
+    (ServingTopology, {"replicas": 0}, "replicas"),
+    (ServingTopology, {"replicas": 2, "replicas_max": 1}, "replicas_max"),
+    (ServingTopology, {"replicas_max": 4}, "slo_ms"),
+    (ServingTopology, {"canary_load": "/ckpt"}, "come\\s+together"),
+    (ServingTopology, {"canary_fraction": 0.5}, "come\\s+together"),
+    (ServingTopology,
+     {"canary_load": "/ckpt", "canary_fraction": 1.5}, "fraction"),
+    (ServingTopology, {"canary_autopromote": True}, "canary_load"),
+    (ChaosTopology, {"interval_s": 0}, "interval_s"),
+    (ChaosTopology, {"max_kills": -1}, "bounds"),
+    (ReconcilePolicy, {"poll_interval_s": 0}, "poll_interval_s"),
+    (ReconcilePolicy, {"backoff_base_s": 5, "backoff_max_s": 1}, "backoff"),
+    (ReconcilePolicy, {"restart_budget": -1}, "restart_budget"),
+    (ModeTopology, {"task": "dance"}, "task"),
+    (ModeTopology, {"fleet_accum": 0}, "fleet_accum"),
+])
+def test_section_bounds(section_cls, kw, msg):
+    with pytest.raises(TopologyError, match=msg):
+        section_cls(**kw)
+
+
+def test_bad_netchaos_schedule_is_a_topology_error():
+    with pytest.raises(TopologyError, match="netchaos"):
+        NetChaosTopology(links={"pod": {"drop": "not-a-schedule"}})
+
+
+def test_backoff_schedule_shape():
+    p = ReconcilePolicy(backoff_base_s=0.5, backoff_max_s=8.0)
+    assert [p.backoff_s(n) for n in (1, 2, 3, 4, 5, 99)] == [
+        0.5, 1.0, 2.0, 4.0, 8.0, 8.0,
+    ]
+
+
+# --------------------------------------------------------------------------
+# exit-2 at both entry points
+# --------------------------------------------------------------------------
+
+
+def test_cli_flag_combos_exit_2(capsys):
+    from distributed_ba3c_tpu.cli import main
+
+    with pytest.raises(SystemExit) as ei:
+        main(["--fleets", "2", "--trainer", "tpu_fused_ba3c",
+              "--env", "jax:pong"])
+    assert ei.value.code == 2
+    assert "fused trainer" in capsys.readouterr().err
+
+
+def test_orchestrate_topology_bad_spec_exits_2(tmp_path, capsys):
+    from distributed_ba3c_tpu.orchestrate.__main__ import main
+
+    p = tmp_path / "bad.json"
+    p.write_text('{"bogus_section": {}}')
+    with pytest.raises(SystemExit) as ei:
+        main(["--topology", str(p)])
+    assert ei.value.code == 2
+    assert "unknown topology fields" in capsys.readouterr().err
+
+
+def test_orchestrate_topology_missing_file_exits_2(tmp_path, capsys):
+    from distributed_ba3c_tpu.orchestrate.__main__ import main
+
+    with pytest.raises(SystemExit) as ei:
+        main(["--topology", str(tmp_path / "nope.json")])
+    assert ei.value.code == 2
+
+
+def test_orchestrate_topology_rejects_train_args(tmp_path, capsys):
+    from distributed_ba3c_tpu.orchestrate.__main__ import main
+
+    p = tmp_path / "spec.json"
+    p.write_text(TopologySpec().to_json())
+    with pytest.raises(SystemExit) as ei:
+        main(["--topology", str(p), "--", "--logdir", "/tmp/x"])
+    assert ei.value.code == 2
+
+
+def test_orchestrate_topology_rejects_mode_mixing(tmp_path):
+    from distributed_ba3c_tpu.orchestrate.__main__ import main
+
+    p = tmp_path / "spec.json"
+    p.write_text(TopologySpec().to_json())
+    with pytest.raises(SystemExit) as ei:
+        main(["--topology", str(p), "--pod_hosts", "2"])
+    assert ei.value.code == 2
+
+
+def test_orchestrate_empty_topology_exits_2(tmp_path, capsys):
+    from distributed_ba3c_tpu.orchestrate.__main__ import main
+
+    p = tmp_path / "spec.json"
+    p.write_text(TopologySpec().to_json())  # no fleets/pod/learner
+    with pytest.raises(SystemExit) as ei:
+        main(["--topology", str(p)])
+    assert ei.value.code == 2
+    assert "names nothing" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------------
+# the fuzz gate: junk in, TopologyError out — never a raw traceback
+# --------------------------------------------------------------------------
+
+_TYPE_CONFUSIONS = [
+    "[]", "17", '"a string"', "null", "true",
+    '{"fleets": {}}',
+    '{"fleets": [[]]}',
+    '{"fleets": [{"fleet_size": "many"}]}',
+    '{"mode": []}',
+    '{"mode": {"task": 3}}',
+    '{"mode": {"fleet_accum": "two"}}',
+    '{"learner": []}',
+    '{"learner": {"logdir": null}}',
+    '{"learner": {"logdir": "x", "train_args": 5}}',
+    '{"learner": {"logdir": "x", "max_restarts": "lots"}}',
+    '{"pod": {"hosts": "two"}}',
+    '{"pod": {"hosts": []}}',
+    '{"serving": {"replicas": null}}',
+    '{"serving": {"canary_fraction": "most"}}',
+    '{"chaos": {"interval_s": "fast"}}',
+    '{"netchaos": {"links": 3}}',
+    '{"netchaos": {"links": {"pod": 3}}}',
+    '{"reconcile": {"poll_interval_s": []}}',
+    '{"reconcile": 0.25}',
+    '{"version": "one"}',
+    '{"version": null}',
+]
+
+
+@pytest.mark.parametrize("text", _TYPE_CONFUSIONS)
+def test_type_confused_docs_never_traceback(text):
+    with pytest.raises(TopologyError):
+        TopologySpec.from_json(text)
+
+
+def test_truncations_never_traceback():
+    whole = full_spec().to_json()
+    for cut in range(0, len(whole), 37):
+        with pytest.raises(TopologyError):
+            TopologySpec.from_json(whole[:cut])
+
+
+def test_seeded_mutation_fuzz_never_tracebacks():
+    """300 seeded mutations of a valid document: flip values to wrong
+    types, inject junk keys, truncate — the outcome is always a clean
+    TopologySpec or a TopologyError, never anything else."""
+    rng = random.Random(0xBA3C)
+    whole = full_spec().to_json()
+    junk_values = ["{}", "[]", "null", '"x"', "-1", "1e99", "true"]
+    for _ in range(300):
+        text = whole
+        op = rng.randrange(3)
+        if op == 0:  # splice junk into a random value position
+            i = rng.randrange(len(text))
+            text = text[:i] + rng.choice(junk_values) + text[i:]
+        elif op == 1:  # random truncation
+            text = text[: rng.randrange(len(text))]
+        else:  # type-confuse one line
+            lines = text.splitlines()
+            k = rng.randrange(len(lines))
+            if ":" in lines[k]:
+                key = lines[k].split(":", 1)[0]
+                lines[k] = f"{key}: {rng.choice(junk_values)},"
+            text = "\n".join(lines)
+        try:
+            TopologySpec.from_json(text)
+        except TopologyError:
+            pass  # the only acceptable failure mode
+
+
+def test_fuzz_through_the_file_entry_point(tmp_path, capsys):
+    """The operator-facing path: a corrupt file exits 2 with a usage
+    message, no traceback on stderr."""
+    from distributed_ba3c_tpu.orchestrate.__main__ import main
+
+    p = tmp_path / "corrupt.json"
+    p.write_text('{"fleets": [{"fleet_size": "many"}]')  # truncated too
+    with pytest.raises(SystemExit) as ei:
+        main(["--topology", str(p)])
+    assert ei.value.code == 2
+    err = capsys.readouterr().err
+    assert "Traceback" not in err

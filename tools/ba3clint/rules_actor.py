@@ -1241,6 +1241,73 @@ class UnroutedPredictorDispatchRule(Rule):
                     )
 
 
+#: liveness probes a hand-rolled supervision loop polls
+_LIVENESS_POLL_ATTRS = {"is_alive", "poll"}
+#: respawn moves the same loop makes — .start() on a thread/process
+#: handle, or a fresh subprocess
+_RESPAWN_ATTRS = {"start"}
+
+
+class AdhocLifecycleLoopRule(Rule):
+    """A15: hand-rolled spawn/health-poll supervision loop outside
+    ``orchestrate/``.
+
+    The reconciler (orchestrate/reconcile.py, docs/topology.md) is the
+    ONE loop that observes liveness and respawns: per-resource
+    exponential backoff, the topology-wide restart-budget circuit
+    breaker, ``tele/reconciler/*`` accounting and a flight-recorded
+    decision trail for every heal. A ``while``/``for`` loop elsewhere
+    whose body both polls liveness (``.is_alive()``/``.poll()``) and
+    spawns (``.start()``/``subprocess.Popen``) is a shadow supervisor:
+    its respawns are unbudgeted (a crash loop spins at poll speed with
+    no breaker), uncounted (the drift gauge and heal counters never see
+    them) and unexplainable post-hoc (no decision trail). Implement the
+    lifecycle as a :class:`Reconcilable` resource driven by the
+    Reconciler instead, or suppress with the justification for why this
+    loop's respawns are otherwise budgeted and accounted (an acceptance
+    bench that IS the measurand of supervision, a test double).
+    Loops that only poll (a wait-for-exit) or only spawn (a launch
+    fan-out) stay clean — the hazard is the closed observe+respawn
+    cycle.
+    """
+
+    id = "A15"
+    name = "adhoc-lifecycle-loop"
+    summary = "spawn/health-poll supervision loop outside orchestrate/ shadows the reconciler"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if "orchestrate" in ctx.path.replace(os.sep, "/").split("/"):
+            return
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.While, ast.For)):
+                continue
+            polls = spawns = False
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Attribute):
+                    if node.func.attr in _LIVENESS_POLL_ATTRS:
+                        polls = True
+                    elif node.func.attr in _RESPAWN_ATTRS:
+                        spawns = True
+                resolved = ctx.info.resolve(node.func)
+                if resolved and (
+                    resolved in _SUBPROCESS_SPAWNERS
+                    or resolved.endswith(".Popen")
+                ):
+                    spawns = True
+            if polls and spawns:
+                yield ctx.finding(
+                    self, loop,
+                    "loop both polls liveness and spawns — a shadow "
+                    "supervisor with no backoff, no restart budget, no "
+                    "heal accounting; make it a Reconcilable resource "
+                    "driven by the orchestrate/ Reconciler, or suppress "
+                    "naming who budgets these respawns "
+                    "(docs/topology.md)",
+                )
+
+
 ACTOR_RULES = [
     BareThreadRule(),
     BlockingQueueOpRule(),
@@ -1256,4 +1323,5 @@ ACTOR_RULES = [
     UnboundedSocketWaitRule(),
     IngestExtraCopyRule(),
     UnroutedPredictorDispatchRule(),
+    AdhocLifecycleLoopRule(),
 ]
