@@ -38,6 +38,14 @@ The registered entry points (one per hot-path jit site):
                           structurally pinned so it cannot silently revert
     fused.actor_bf16      the overlap rollout program at the bf16 params
                           snapshot (fused.prep's cast output) — same pin
+    predict.server_int8   the int8 serving forward (--rollout_dtype int8):
+                          per-channel symmetric int8 weights + calibrated
+                          per-tensor activation scales (quantize/), int8
+                          conv accumulate-to-int32, f32 epilogue + heads —
+                          the quarter-bandwidth rung, structurally pinned
+    fused.actor_int8      the overlap rollout program at the int8 qparams
+                          snapshot (fused.prep quantizes on snapshot) —
+                          same donation/collective-free contract
     pod.learner           the pod's bounded-staleness V-trace learner
                           (pod/learner.py) — the fused.learner gradient
                           body compiled standalone for host-fed blocks
@@ -741,6 +749,103 @@ def _build_overlap_actor_bf16() -> TraceTarget:
             offset=len(jax.tree_util.tree_leaves(params)),
         ),
         allow_collectives=False,
+    )
+
+
+def _int8_qparams(model, params_avals):
+    """f32 param avals → quantized-table avals (what the predictor's
+    publish-quantize / fused.prep's snapshot-quantize hands the int8
+    programs). The spec's SCALE VALUES never shape the program — one
+    compiled forward per shape class serves every calibration — so a
+    placeholder all-1.0 spec yields the exact avals the live table has."""
+    import jax
+
+    from distributed_ba3c_tpu.quantize import QuantSpec, quant_layer_names, quantize_params
+
+    spec = QuantSpec(
+        act_scales={n: 1.0 for n in quant_layer_names(model)}
+    )
+    return jax.eval_shape(lambda p: quantize_params(p, spec), params_avals)
+
+
+@register_entry("predict.server_int8")
+def _build_predict_server_int8() -> TraceTarget:
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_ba3c_tpu.quantize import make_quant_fwd_sample
+
+    cfg, model, opt = _canonical_parts()
+    qparams = _int8_qparams(model, _state_avals(model, cfg, opt).params)
+    B = 16  # same canonical bucket as predict.server
+    states = jax.ShapeDtypeStruct((B, *cfg.state_shape), jnp.uint8)
+    return TraceTarget(
+        # the int8 serving forward (--rollout_dtype int8): same packed-fetch
+        # contract as predict.server, int8 param STORAGE with per-channel
+        # weight scales riding in the table — T1 here requires every conv
+        # to run int8×int8 (accumulate-to-int32 via preferred_element_type;
+        # a dequantize-first regression shows up as f32 operands), and T5
+        # pins the quartered param reads on their own row
+        name="predict.server_int8",
+        jit_fn=jax.jit(make_quant_fwd_sample(model, greedy=False)),
+        args=(qparams, states, _key_aval()),
+        grad_shapes=None,
+        donated_nonscalar_indices=[],
+        allow_collectives=False,
+        conv_dtype="int8",
+    )
+
+
+@register_entry("fused.actor_int8")
+def _build_overlap_actor_int8() -> TraceTarget:
+    import jax
+
+    from distributed_ba3c_tpu.envs.jaxenv import pong
+    from distributed_ba3c_tpu.fused.loop import create_fused_state
+    from distributed_ba3c_tpu.fused.overlap import ActorState, make_overlap_step
+    from distributed_ba3c_tpu.quantize import QuantSpec, quant_layer_names
+
+    cfg, model, opt = _canonical_parts()
+    mesh = canonical_mesh()
+    n_envs = 2 * CANONICAL_MESH_DEVICES  # 2 envs per canonical shard
+    spec = QuantSpec(
+        act_scales={n: 1.0 for n in quant_layer_names(model)}
+    )
+    step = make_overlap_step(
+        model, opt, cfg, mesh, pong, rollout_len=4,
+        rollout_dtype="int8", quant_spec=spec,
+    )
+    state = jax.eval_shape(
+        lambda k: create_fused_state(
+            k, model, cfg, opt, pong, n_envs,
+            n_shards=CANONICAL_MESH_DEVICES,
+        ),
+        _key_aval(),
+    )
+    astate = ActorState(
+        env_state=state.env_state,
+        obs_stack=state.obs_stack,
+        key=state.key,
+        ep_return=state.ep_return,
+        ep_count=state.ep_count,
+        ep_return_sum=state.ep_return_sum,
+    )
+    qparams = _int8_qparams(model, state.train.params)
+    return TraceTarget(
+        # the overlap rollout at the int8 qparams snapshot (fused.prep
+        # quantizes on snapshot): same donation-aliased env carry and
+        # collective-free contract as fused.actor/_bf16, traced at the
+        # quantized-table avals the int8 schedule actually feeds it
+        name="fused.actor_int8",
+        jit_fn=step.actor_jit,
+        args=(qparams, astate),
+        grad_shapes=None,
+        donated_nonscalar_indices=_donated_indices(
+            astate,
+            offset=len(jax.tree_util.tree_leaves(qparams)),
+        ),
+        allow_collectives=False,
+        conv_dtype="int8",
     )
 
 

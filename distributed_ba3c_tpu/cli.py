@@ -208,7 +208,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_to_keep", type=int, default=3, help="checkpoints retained (besides best); raise to keep every eval-epoch checkpoint for post-hoc crossing verification")
     p.add_argument("--steps_per_dispatch", type=int, default=1, help="fused trainer: wrap K update steps in one lax.scan program (one host dispatch per K updates; must divide --steps_per_epoch). Removes per-step dispatch overhead without relying on host pipelining. With --overlap, K actor/learner dispatch PAIRS per facade call instead")
     p.add_argument("--overlap", action="store_true", help="fused trainer: split the single fused program into two overlapped compiled programs — rollout k+1 runs concurrently with learner k (policy lag 1, V-trace-corrected; docs/overlap.md)")
-    p.add_argument("--rollout_dtype", default="float32", choices=["float32", "bfloat16"], help="rollout/serving forward precision, END TO END (the learner always keeps f32): with --overlap it is the actor program's params-snapshot dtype; on the ZMQ trainers it is the BatchedPredictor's param storage (every policy publish casts on device). bfloat16 halves the forward's param-read bandwidth; the heads stay f32 and V-trace clips the precision noise. Audit-pinned as predict.server_bf16 / fused.actor_bf16")
+    p.add_argument("--rollout_dtype", default="float32", choices=["float32", "bfloat16", "int8"], help="rollout/serving forward precision, END TO END (the learner always keeps f32): with --overlap it is the actor program's params-snapshot dtype; on the ZMQ trainers it is the BatchedPredictor's param storage (every policy publish casts on device). bfloat16 halves the forward's param-read bandwidth; int8 quarters it with per-channel symmetric weight quantization (requires a calibration source: --quant_spec or --quant_calibrate; heads stay f32; docs/ingest.md). Audit-pinned as predict.server_bf16 / fused.actor_bf16 / predict.server_int8 / fused.actor_int8")
+    p.add_argument("--quant_spec", default=None, help="int8 rung: path to a frozen QuantSpec JSON (quantize/spec.py) carrying the per-layer activation scales — the offline/pre-frozen calibration source. Exactly one of --quant_spec / --quant_calibrate with --rollout_dtype int8")
+    p.add_argument("--quant_calibrate", type=int, default=0, help="int8 rung: calibrate activation scales live from the first N served batches (ZMQ trainers: the PR-9 shadow tap observes real traffic, serving stays f32 until the spec freezes, then the plane switches to int8 in place; fused --overlap trainer: N f32 rollout windows through the actor's own scan body before the int8 program is built). 0 = off")
     p.add_argument("--ingest_staging", default="on", choices=["on", "off"], help="ZMQ trainers: zero-copy pinned-staging ingest (data/staging.py) — collate writes obs bytes straight into preallocated double-buffered staging arrays (ONE host copy per block, ingest_copies_total proves it) and the next batch's H2D dispatches behind the running step. off = the legacy materialize->collate->device_put chain (the plane_bench --ingest foil)")
     p.add_argument("--rank_stall_timeout", type=float, default=0, help="multi-host: seconds without proven progress (beats land after the dispatch-window metrics fetch, after eval, and after the collective save) before a rank declares a peer dead and exits 75 (0 = default 600s when multi-host; -1 disables the watchdog; the limit self-raises to 2x the slowest healthy window). Relaunch with --load to resume")
     p.add_argument("--seed", type=int, default=0, help="fused trainer: PRNG seed for params/envs/action sampling (whole-trajectory determinism per seed; multi-seed runs disclose seed selection in RESULTS.md)")
@@ -577,6 +579,15 @@ def main(argv: Optional[list] = None) -> int:
                 ("shadow", _policy_params(args.shadow_load), None)
             )
 
+    # int8 rung: a frozen spec file is loaded ONCE and shared by every
+    # replica (one calibration per plane); --quant_calibrate instead hands
+    # each replica a live CalibrationTap over its own served traffic
+    _quant_spec = None
+    if args.quant_spec:
+        from distributed_ba3c_tpu.quantize import QuantSpec
+
+        _quant_spec = QuantSpec.load(args.quant_spec)
+
     def _build_replica(tele_role_r: str):
         # THE sanctioned serving factory: handed to the fleet assembly
         # (and to the ReplicaSet under --serve_replicas), lifecycle owned
@@ -588,10 +599,13 @@ def main(argv: Optional[list] = None) -> int:
             num_threads=cfg.predictor_threads,
             slo_ms=args.serve_slo_ms,
             tele_role=tele_role_r,
-            # the quantized rollout forward (--rollout_dtype bfloat16):
+            # the quantized rollout forward (--rollout_dtype bfloat16/int8):
             # serving-side param storage only — the learner publishes and
-            # keeps full precision (audit entry predict.server_bf16)
+            # keeps full precision (audit entries predict.server_bf16 /
+            # predict.server_int8)
             rollout_dtype=args.rollout_dtype,
+            quant_spec=_quant_spec,
+            quant_calibrate=args.quant_calibrate,
         )
 
     # serving-plane control loops grown by the routed path (the per-fleet

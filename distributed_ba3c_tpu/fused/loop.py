@@ -74,7 +74,7 @@ class FusedState(struct.PyTreeNode):
 
 
 def make_rollout_body(model, cfg: BA3CConfig, env, params,
-                      record_log_probs: bool = False):
+                      record_log_probs: bool = False, apply_fn=None):
     """The per-step rollout scan body — ONE implementation shared by the
     fused step and the overlap actor program (fused/overlap.py).
 
@@ -85,12 +85,19 @@ def make_rollout_body(model, cfg: BA3CConfig, env, params,
     log mu(a_t|s_t) of the sampled action (the V-trace behavior term);
     without it the emitted jaxpr is unchanged from the pre-split fused
     body (the audit manifest pins that).
+
+    ``apply_fn(params, stack) -> PolicyValue`` overrides the forward
+    while keeping the key sequence/sampling math identical — the int8
+    actor program (quantize/qforward.py) passes its quantized apply and
+    ``params`` becomes the int8 serving table.
     """
+    if apply_fn is None:
+        apply_fn = lambda p, stack: model.apply({"params": p}, stack)  # noqa: E731
 
     def rollout_body(carry, _):
         env_state, stack, key, ep_ret, ep_cnt, ep_sum = carry
         B = stack.shape[0]
-        out = model.apply({"params": params}, stack)
+        out = apply_fn(params, stack)
         key, k_act, k_env = jax.random.split(key, 3)
         actions = jax.random.categorical(k_act, out.logits, axis=-1).astype(
             jnp.int32
@@ -570,6 +577,41 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
             f"--steps_per_epoch {args.steps_per_epoch}"
         )
     fleet_accum = max(1, getattr(args, "fleet_accum", 1) or 1)
+    # state BEFORE the step build: the int8 rung's pre-training env
+    # calibration needs the run's actual starting params (restored ones
+    # on a resume — calibrating against re-initialized weights would
+    # freeze scales for a policy the actor never plays)
+    state = create_fused_state(
+        jax.random.PRNGKey(getattr(args, "seed", 0) or 0),
+        model, cfg, optimizer, env, n_envs, n_shards=n_data,
+    )
+    if args.load:
+        mgr = CheckpointManager(args.load)
+        restored = mgr.restore(jax.device_get(state.train))
+        state = state.replace(train=restored)
+        logger.info("resumed train state at step %d", int(restored.step))
+    rollout_dtype = getattr(args, "rollout_dtype", "float32")
+    quant_spec = None
+    if rollout_dtype == "int8":
+        # calibration source resolution (cli.py/TopologySpec validated
+        # exactly-one-of): a frozen spec file, or N offline env-rollout
+        # windows through the same scan body the actor program runs
+        from distributed_ba3c_tpu.quantize import QuantSpec, calibrate_from_env
+
+        if getattr(args, "quant_spec", None):
+            quant_spec = QuantSpec.load(args.quant_spec)
+        else:
+            quant_spec = calibrate_from_env(
+                model, cfg, env, state.train.params,
+                jax.random.PRNGKey(getattr(args, "seed", 0) or 0),
+                n_envs=n_envs,
+                batches=int(getattr(args, "quant_calibrate", 0) or 0),
+                rollout_len=rollout_len,
+            )
+        logger.info(
+            "int8 rollout forward: quant spec %s (%d calibration batches)",
+            quant_spec.sha256()[:12], quant_spec.calibration_batches,
+        )
     if getattr(args, "overlap", False):
         # two overlapped compiled programs (rollout k+1 concurrent with
         # learner k, lag-1 V-trace correction) instead of the single fused
@@ -582,8 +624,9 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
             model, optimizer, cfg, mesh, env, rollout_len,
             grad_chunk_samples=args.grad_chunk_samples,
             steps_per_dispatch=k_dispatch,
-            rollout_dtype=getattr(args, "rollout_dtype", "float32"),
+            rollout_dtype=rollout_dtype,
             macro_fleets=fleet_accum,
+            quant_spec=quant_spec,
         )
     else:
         step = make_fused_step(
@@ -591,15 +634,6 @@ def run_fused_training(args, cfg: BA3CConfig, model, optimizer) -> int:
             grad_chunk_samples=args.grad_chunk_samples,
             steps_per_dispatch=k_dispatch,
         )
-    state = create_fused_state(
-        jax.random.PRNGKey(getattr(args, "seed", 0) or 0),
-        model, cfg, optimizer, env, n_envs, n_shards=n_data,
-    )
-    if args.load:
-        mgr = CheckpointManager(args.load)
-        restored = mgr.restore(jax.device_get(state.train))
-        state = state.replace(train=restored)
-        logger.info("resumed train state at step %d", int(restored.step))
     run_shape = {
         "steps_per_epoch": args.steps_per_epoch,
         "batch_size": cfg.batch_size,

@@ -87,7 +87,7 @@ from distributed_ba3c_tpu.parallel.train_step import (
 
 import optax
 
-ROLLOUT_DTYPES = ("float32", "bfloat16")
+ROLLOUT_DTYPES = ("float32", "bfloat16", "int8")
 
 
 def make_block_grads(
@@ -298,6 +298,7 @@ def make_overlap_step(
     lag: int = 1,
     rollout_dtype: str = "float32",
     macro_fleets: int = 1,
+    quant_spec=None,
 ) -> Callable:
     """Build the overlapped two-program step facade.
 
@@ -316,6 +317,14 @@ def make_overlap_step(
     shape (the macro-batching contract); behavior lag within the window
     spans 1..K updates and V-trace's clipped importance weights correct
     it exactly as they do the lag-1 schedule.
+
+    ``rollout_dtype="int8"`` builds the quantized actor program (audit
+    entry ``fused.actor_int8``): ``quant_spec`` (a calibrated
+    :class:`~distributed_ba3c_tpu.quantize.spec.QuantSpec`) is REQUIRED,
+    the prep step becomes quantize-on-snapshot (``quantize_params``) and
+    the rollout body's forward runs the dequant-free int8 mirror
+    (quantize/qforward.py). The learner half is untouched — f32
+    throughout, exactly like the bf16 rung.
     """
     if lag not in (0, 1):
         raise ValueError(f"lag must be 0 or 1, got {lag}")
@@ -323,14 +332,29 @@ def make_overlap_step(
         raise ValueError(
             f"rollout_dtype must be one of {ROLLOUT_DTYPES}, got {rollout_dtype!r}"
         )
+    if rollout_dtype == "int8" and quant_spec is None:
+        raise ValueError(
+            "rollout_dtype='int8' needs a calibrated quant_spec (load one "
+            "with QuantSpec.load, or calibrate via quantize.calibrate)"
+        )
     if macro_fleets < 1:
         raise ValueError(f"macro_fleets must be >= 1, got {macro_fleets}")
+    if rollout_dtype == "int8":
+        from distributed_ba3c_tpu.quantize import (
+            make_quant_apply,
+            quantize_params,
+        )
+
+        quant_apply = make_quant_apply(model, arm="auto")
+    else:
+        quant_apply = None
 
     # ---------------- actor program (fused.actor) -------------------------
     def local_actor(params, astate: ActorState):
         key = astate.key[0]  # this shard's scalar key
         rollout_body = make_rollout_body(
-            model, cfg, env, params, record_log_probs=True
+            model, cfg, env, params, record_log_probs=True,
+            apply_fn=quant_apply,
         )
         carry0 = (
             astate.env_state,
@@ -399,12 +423,19 @@ def make_overlap_step(
     actor_jit = tripwire_jit("fused.actor", actor_sharded, donate_argnums=(1,))
 
     # ---------------- prep: the params snapshot ----------------------------
-    if rollout_dtype == "bfloat16":
+    if rollout_dtype == "int8":
+        def prep_fn(params):
+            # quantize-on-snapshot: the f32 learner params become the
+            # int8 serving table (per-channel weight scales + the frozen
+            # activation scales riding in) — every cast lives in
+            # quantize/qforward.py behind the fused.actor_int8 audit
+            return quantize_params(params, quant_spec)
+    elif rollout_dtype == "bfloat16":
         def prep_fn(params):
             # the cast IS the snapshot: bf16 actor-side forward (the
             # block only feeds behavior logits that V-trace clips)
             return jax.tree_util.tree_map(
-                lambda x: x.astype(jnp.bfloat16)
+                lambda x: x.astype(jnp.bfloat16)  # ba3clint: disable=A16 — THE audited publish cast (entry fused.actor_bf16)
                 if x.dtype == jnp.float32 else x,
                 params,
             )
@@ -685,6 +716,7 @@ def make_overlap_step(
     step.steps_per_dispatch = steps_per_dispatch
     step.lag = lag
     step.rollout_dtype = rollout_dtype
+    step.quant_spec = quant_spec
     step.macro_fleets = macro_fleets
     step.reset_episode_stats = reset_episode_stats
     step.probe_overlap = probe_overlap

@@ -36,6 +36,22 @@ class PolicyValue(NamedTuple):
     value: jax.Array   # [B] float32
 
 
+def conv_layout(model: "BA3CNet") -> Tuple[Tuple[int, int, bool], ...]:
+    """The conv stack's (features, kernel, pooled) triples — the ONE
+    layout description shared by :meth:`BA3CNet.__call__` and the
+    quantized mirror forward (distributed_ba3c_tpu/quantize/), so the
+    int8 program can never drift from the f32 architecture it
+    quantizes."""
+    return tuple(
+        zip(
+            model.conv_features,
+            model.conv_kernels,
+            model.pooled_layers,
+            strict=True,
+        )
+    )
+
+
 def _conv_spec(x: jax.Array, features: int, k: int, pooled: bool):
     """The ONE ConvSpec construction shared by the gate and the executed
     block, so they can never diverge (ops/pallas_conv.py)."""
@@ -109,14 +125,8 @@ class BA3CNet(nn.Module):
         else:
             x = state.astype(self.compute_dtype)
 
-        for i, (feats, k, pooled, pack) in enumerate(
-            zip(
-                self.conv_features,
-                self.conv_kernels,
-                self.pooled_layers,
-                self.conv_pack,
-                strict=True,
-            )
+        for i, ((feats, k, pooled), pack) in enumerate(
+            zip(conv_layout(self), self.conv_pack, strict=True)
         ):
             # explicit name "Conv_i" for ALL branches: PackedConv and
             # _PallasConvBlock own nn.Conv-shaped params, so checkpoints

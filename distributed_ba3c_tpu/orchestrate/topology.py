@@ -257,6 +257,9 @@ class ModeTopology:
     fleet_accum: int = 1
     steps_per_epoch: int = 6000
     steps_per_dispatch: int = 1
+    rollout_dtype: str = "float32"
+    quant_spec: str = ""
+    quant_calibrate: int = 0
 
     def __post_init__(self):
         if self.task not in ("train", "eval", "play", "dump_config"):
@@ -267,6 +270,28 @@ class ModeTopology:
             )
         if self.steps_per_dispatch < 1 or self.steps_per_epoch < 1:
             raise TopologyError("mode step counts must be >= 1")
+        if self.rollout_dtype not in ("float32", "bfloat16", "int8"):
+            raise TopologyError(
+                f"unknown mode.rollout_dtype {self.rollout_dtype!r} "
+                "(float32 | bfloat16 | int8)"
+            )
+        if self.quant_calibrate < 0:
+            raise TopologyError(
+                f"mode.quant_calibrate must be >= 0, got "
+                f"{self.quant_calibrate}"
+            )
+        if self.rollout_dtype == "int8":
+            if bool(self.quant_spec) == bool(self.quant_calibrate):
+                raise TopologyError(
+                    "rollout_dtype int8 needs exactly ONE calibration "
+                    "source: a frozen quant_spec file OR quant_calibrate N "
+                    "live batches (docs/ingest.md)"
+                )
+        elif self.quant_spec or self.quant_calibrate:
+            raise TopologyError(
+                "quant_spec/quant_calibrate calibrate the int8 rung — "
+                f"they do not apply to rollout_dtype {self.rollout_dtype!r}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -338,6 +363,17 @@ class TopologySpec:
                 "train task — the fused trainer has no actor plane (its "
                 "macro-batching knob is fleet_accum with overlap), and "
                 "eval/play spawn no fleet"
+            )
+        if (
+            m.rollout_dtype == "int8"
+            and m.trainer == "tpu_fused_ba3c"
+            and not m.overlap
+        ):
+            raise TopologyError(
+                "rollout_dtype int8 on the fused trainer quantizes the "
+                "ACTOR program's params snapshot — it requires overlap "
+                "(the monolithic fused program has no separate actor "
+                "forward to quantize)"
             )
         if m.fleet_accum > 1 and not m.overlap:
             raise TopologyError(
@@ -513,6 +549,9 @@ class TopologySpec:
             fleet_accum=getattr(args, "fleet_accum", 1),
             steps_per_epoch=args.steps_per_epoch,
             steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
+            rollout_dtype=getattr(args, "rollout_dtype", "float32"),
+            quant_spec=getattr(args, "quant_spec", None) or "",
+            quant_calibrate=int(getattr(args, "quant_calibrate", 0) or 0),
         )
         fleets: Tuple[FleetSpec, ...] = ()
         external = args.env.startswith("zmq:")

@@ -273,17 +273,38 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
         "--rollout_dtype", default="float32",
-        choices=["float32", "bfloat16"],
+        choices=["float32", "bfloat16", "int8"],
         help="the host predictor's param-storage precision (the cached "
         "params arrive f32 from the learner and are cast at publish; "
-        "audit entry predict.server_bf16) — the actor-host half of the "
-        "quantized rollout forward",
+        "audit entries predict.server_bf16 / predict.server_int8) — the "
+        "actor-host half of the quantized rollout forward. int8 requires "
+        "--quant_spec (pod hosts calibrate nothing: the spec is frozen "
+        "once, centrally, and shipped to every host so the fleet serves "
+        "ONE quantization)",
+    )
+    p.add_argument(
+        "--quant_spec", default=None,
+        help="frozen QuantSpec JSON for --rollout_dtype int8 "
+        "(distributed_ba3c_tpu/quantize/; calibrate centrally via the "
+        "serving tier's CalibrationTap or quantize.calibrate_offline)",
     )
     return p
 
 
 def main(argv: Optional[list] = None) -> int:
-    args = make_parser().parse_args(argv)
+    parser = make_parser()
+    args = parser.parse_args(argv)
+    # exit-2 usage errors, not tracebacks: the int8 rung needs its frozen
+    # calibration, and a spec on a non-int8 host is a confused launch
+    if args.rollout_dtype == "int8" and not args.quant_spec:
+        parser.error(
+            "--rollout_dtype int8 requires --quant_spec FILE (pod hosts "
+            "serve a centrally frozen calibration — see docs/ingest.md)"
+        )
+    if args.quant_spec and args.rollout_dtype != "int8":
+        parser.error(
+            "--quant_spec only applies to --rollout_dtype int8"
+        )
     role = pod_role(args.host_id)
 
     # the host is CPU-only BY CONTRACT (it must never contend for the
@@ -311,6 +332,16 @@ def main(argv: Optional[list] = None) -> int:
         local_time_max=args.unroll_len,
     )
     model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    quant_spec = None
+    if args.quant_spec:
+        from distributed_ba3c_tpu.quantize import QuantSpec
+
+        quant_spec = QuantSpec.load(args.quant_spec)
+        logger.info(
+            "[pod host %d] int8 serving from frozen spec %s (%s, %d batches)",
+            args.host_id, quant_spec.sha256()[:12], quant_spec.method,
+            quant_spec.calibration_batches,
+        )
     endpoints = pod_endpoints(args.learner_c2s, args.learner_s2c)
 
     # 1. params plane first: there is nothing to roll out before a policy
@@ -337,6 +368,7 @@ def main(argv: Optional[list] = None) -> int:
         seed=args.seed + 1000 * args.host_id,
         tele_role="predictor",
         rollout_dtype=args.rollout_dtype,
+        quant_spec=quant_spec,
     )
     predictor.warmup(cfg.state_shape)
     cache.on_update(lambda params, version: predictor.update_params(params))

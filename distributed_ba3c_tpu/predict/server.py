@@ -70,8 +70,10 @@ def _next_pow2(n: int) -> int:
 #: serving/actor forward precisions (the learner always keeps f32 — this
 #: only selects the PARAMS precision of the serving program, the overlap
 #: prep-cast extended to the ZMQ serving plane; models/a3c.py keeps the
-#: policy/value heads f32 either way)
-ROLLOUT_DTYPES = ("float32", "bfloat16")
+#: policy/value heads f32 either way). ``int8`` additionally needs a
+#: calibration source: a frozen QuantSpec, or N live-traffic batches
+#: through the CalibrationTap (distributed_ba3c_tpu/quantize/).
+ROLLOUT_DTYPES = ("float32", "bfloat16", "int8")
 
 
 class _StagePool:
@@ -317,6 +319,10 @@ class BatchedPredictor:
         clock: Optional[Callable[[], float]] = None,
         tele_role: str = "predictor",
         rollout_dtype: str = "float32",
+        quant_spec=None,
+        quant_calibrate: int = 0,
+        quant_method: str = "absmax",
+        quant_percentile: float = 99.9,
     ):
         import time as _time
 
@@ -327,7 +333,27 @@ class BatchedPredictor:
                 f"rollout_dtype must be one of {ROLLOUT_DTYPES}, got "
                 f"{rollout_dtype!r}"
             )
+        if rollout_dtype == "int8":
+            if (quant_spec is None) == (not quant_calibrate):
+                raise ValueError(
+                    "rollout_dtype='int8' needs exactly ONE calibration "
+                    "source: a frozen quant_spec, or quant_calibrate=N "
+                    "live batches through the CalibrationTap"
+                )
+        elif quant_spec is not None or quant_calibrate:
+            raise ValueError(
+                "quant_spec/quant_calibrate configure the int8 rung — "
+                f"they do not apply to rollout_dtype={rollout_dtype!r}"
+            )
         self.rollout_dtype = rollout_dtype
+        #: the ACTIVE QuantSpec (int8 serving) — None while f32/bf16, and
+        #: None during the live-calibration window (f32 serving until the
+        #: tap freezes and the table switches)
+        self.quant_spec = None
+        # sync-path consistency guard: _switch_to_int8 swaps the compiled
+        # program and the policy table together under this lock; the
+        # scheduler thread never needs it (the switch runs ON it)
+        self._swap_lock = threading.Lock()
         if rollout_dtype == "bfloat16":
             # the overlap split's prep-cast, serving edition: every policy
             # publish casts f32 params to bf16 ON DEVICE (one small pass,
@@ -337,10 +363,22 @@ class BatchedPredictor:
             # V-trace clips whatever noise the storage cast adds
             self._cast_params = jax.jit(
                 lambda p: jax.tree_util.tree_map(
-                    lambda x: x.astype(jnp.bfloat16)
+                    lambda x: x.astype(jnp.bfloat16)  # ba3clint: disable=A16 — THE audited publish cast (entry predict.server_bf16)
                     if x.dtype == jnp.float32 else x,
                     p,
                 )
+            )
+        elif rollout_dtype == "int8" and quant_spec is not None:
+            # quantize-on-publish (the bf16 cast's int8 edition): every
+            # policy publish runs the f32 -> int8 table build in
+            # quantize/qforward.py — per-channel weight scales + the
+            # spec's frozen activation scales; the compiled forward
+            # depends only on avals, so ONE program serves every publish
+            from distributed_ba3c_tpu.quantize import quantize_params
+
+            self.quant_spec = quant_spec
+            self._cast_params = jax.jit(
+                lambda p: quantize_params(p, quant_spec)
             )
         else:
             self._cast_params = None
@@ -436,16 +474,41 @@ class BatchedPredictor:
         # compile sequence; warmup() arms the tripwire when it completes, so
         # only a new bucket size appearing mid-serving raises. Continuous
         # batching keeps this contract: every group is padded to a warmed
-        # bucket before dispatch. The bf16 variant is its own entry point
-        # (predict.server_bf16): a different program, its own T1/T2/T5 pin.
+        # bucket before dispatch. The bf16/int8 variants are their own entry
+        # points (predict.server_bf16 / predict.server_int8): different
+        # programs, their own T1/T2/T5 pins.
         entry = "predict.server_greedy" if greedy else "predict.server"
         if rollout_dtype == "bfloat16":
             entry += "_bf16"
-        self._fwd = tripwire_jit(
-            entry,
-            make_fwd_sample(model, greedy),
-            auto_arm=False,
-        )
+        if self.quant_spec is not None:
+            from distributed_ba3c_tpu.quantize import make_quant_fwd_sample
+
+            self._fwd = tripwire_jit(
+                entry + "_int8",
+                make_quant_fwd_sample(model, greedy),
+                auto_arm=False,
+            )
+        else:
+            self._fwd = tripwire_jit(
+                entry,
+                make_fwd_sample(model, greedy),
+                auto_arm=False,
+            )
+        # the live-calibration window (rollout_dtype='int8' without a
+        # frozen spec): serve f32 while the PR-9 shadow plane mirrors
+        # every batch through the CalibrationTap; after N batches the tap
+        # freezes and _switch_to_int8 swaps program + table in place
+        self._warm_shape = None
+        self._warm_dtype = None
+        if rollout_dtype == "int8" and self.quant_spec is None:
+            from distributed_ba3c_tpu.quantize import CalibrationTap
+
+            self.shadow_tap = CalibrationTap(
+                model, params, quant_calibrate,
+                method=quant_method, percentile=quant_percentile,
+                on_freeze=self._switch_to_int8, tele_role=tele_role,
+            )
+            self._shadow = "default"
         self.threads: List[StoppableThread] = [
             StoppableThread(
                 target=self._scheduler, daemon=True, name="predictor-sched"
@@ -465,6 +528,11 @@ class BatchedPredictor:
         stalls the whole actor plane. Call once before actors start (and
         after ``add_policy`` — same program, but the warmup proves the
         shapes through)."""
+        # remembered for the int8 calibration switch: the swapped-in
+        # quantized program must re-prove the same buckets before it
+        # takes traffic (same mid-serving-stall contract)
+        self._warm_shape = tuple(state_shape)
+        self._warm_dtype = dtype
         b = 1
         while b <= _next_pow2(self._batch_size):
             self._run_device(np.zeros((b, *state_shape), dtype))
@@ -485,7 +553,86 @@ class BatchedPredictor:
             if t.is_alive():
                 t.join(timeout)
 
+    # -- the int8 calibration switch ---------------------------------------
+    @property
+    def serving_dtype(self) -> str:
+        """The precision the table SERVES right now: ``rollout_dtype``,
+        except during the int8 live-calibration window (f32 until the
+        tap freezes and the switch lands)."""
+        if self.rollout_dtype != "int8":
+            return self.rollout_dtype
+        return "int8" if self.quant_spec is not None else "float32"
+
+    def _switch_to_int8(self, spec) -> None:
+        """The CalibrationTap's freeze hook: swap the serving plane to
+        int8 IN PLACE — quantize every hot policy, replace the compiled
+        program (audit entry gains its ``_int8`` suffix), re-prove the
+        warmed buckets, retire the shadow mirror.
+
+        Runs on the scheduler thread (the tap fires from the shadow
+        fetch path), so no async dispatch is concurrent with the swap;
+        ``_swap_lock`` covers the sync ``predict_batch`` path."""
+        from distributed_ba3c_tpu.quantize import (
+            make_quant_fwd_sample,
+            quantize_params,
+        )
+
+        quantize = jax.jit(lambda p: quantize_params(p, spec))
+        entry = "predict.server_greedy" if self._greedy else "predict.server"
+        fwd = tripwire_jit(
+            entry + "_int8",
+            make_quant_fwd_sample(self._model, self._greedy),
+            auto_arm=False,
+        )
+        while True:
+            # quantize OUTSIDE the lock (device work), commit only if no
+            # publish replaced an entry meanwhile — else a fresh f32
+            # table would be silently dropped by the rebind
+            snapshot = dict(self._policies)
+            table = {pid: quantize(p) for pid, p in snapshot.items()}
+            with self._swap_lock:
+                current = self._policies
+                if len(current) == len(snapshot) and all(
+                    current.get(pid) is p for pid, p in snapshot.items()
+                ):
+                    self._cast_params = quantize
+                    self._policies = table
+                    self._fwd = fwd
+                    self.quant_spec = spec
+                    break
+        # shadow plane retired: the tap saw its N batches; from here the
+        # mirror would only double device work
+        self._shadow = None
+        self.shadow_tap = None
+        if self._warm_shape is not None:
+            # re-prove the warmed buckets through the NEW program (its
+            # own compile set), then re-arm the retrace tripwire
+            b = 1
+            while b <= _next_pow2(self._batch_size):
+                self._run_device(
+                    np.zeros((b, *self._warm_shape), self._warm_dtype)
+                )
+                b *= 2
+            getattr(self._fwd, "arm", lambda: None)()
+        self._c_publishes.inc()
+
     # -- policy table ------------------------------------------------------
+    def _publish_policy(self, policy_id: str, params) -> None:
+        """Commit a publish to the table — cast/quantize OUTSIDE the swap
+        lock (device work), then store only if the serving program didn't
+        change underneath: a publish racing ``_switch_to_int8`` must land
+        through the NEW cast, never as an f32 table behind the int8
+        program."""
+        while True:
+            cast = self._cast_params
+            p = jax.device_put(params)
+            if cast is not None:
+                p = cast(p)
+            with self._swap_lock:
+                if self._cast_params is cast:
+                    self._policies[policy_id] = p
+                    return
+
     def _put_policy(self, params):
         """Params → the serving table's storage: device-resident, cast to
         the rollout dtype (bf16 mode) — ONE place, so every publish path
@@ -505,7 +652,7 @@ class BatchedPredictor:
                 f"policy id {policy_id!r} must match {_POLICY_ID_RE.pattern} "
                 "(it names Prometheus series)"
             )
-        self._policies[policy_id] = self._put_policy(params)
+        self._publish_policy(policy_id, params)
         self._c_policy_rows.setdefault(
             policy_id, self._tele.counter(f"policy_{policy_id}_rows_total")
         )
@@ -546,11 +693,7 @@ class BatchedPredictor:
         keeps serving its stale weights."""
         if policy not in self._policies:
             raise KeyError(f"unknown policy {policy!r} — add_policy first")
-        if self._cast_params is not None:
-            # learner publishes stay full precision; the CAST is the
-            # serving table's own storage step (atomic swap after)
-            params = self._cast_params(params)
-        self._policies[policy] = params
+        self._publish_policy(policy, params)
         self._c_publishes.inc()
 
     # -- API ---------------------------------------------------------------
@@ -733,13 +876,15 @@ class BatchedPredictor:
             self._key, sub = jax.random.split(self._key)
         return sub
 
-    def _dispatch(self, params, batch: np.ndarray):
+    def _dispatch(self, params, batch: np.ndarray, fwd=None):
         """Pad to the pow-2 bucket and dispatch (async); NO host fetch —
         the scheduler fetches via :meth:`_collect` only after the next
         group is dispatched.
 
         ``params`` is passed explicitly so a multi-chunk caller serves ONE
-        parameter version even if the learner publishes mid-batch."""
+        parameter version even if the learner publishes mid-batch; ``fwd``
+        likewise pins the compiled program across a chunked call (the
+        int8 calibration switch swaps ``self._fwd`` mid-serving)."""
         # device ingest is where a lazy block-states view (block-shm wire)
         # pays its one materialization — jit can't take a BlockStatesView
         batch = np.asarray(batch)
@@ -748,7 +893,9 @@ class BatchedPredictor:
         if padded != k:
             pad = np.zeros((padded - k, *batch.shape[1:]), batch.dtype)
             batch = np.concatenate([batch, pad], axis=0)
-        return k, self._fwd(params, batch, self._next_key())
+        return k, (fwd if fwd is not None else self._fwd)(
+            params, batch, self._next_key()
+        )
 
     def _collect(self, handle):
         """ONE device->host fetch of a dispatched call (see fwd_sample)."""
@@ -784,11 +931,15 @@ class BatchedPredictor:
         Params are snapshotted once per call: a learner publish mid-call
         must not split one logical batch across two policies."""
         cap = _next_pow2(max(self._batch_size, 1))
+        # program + table snapshotted TOGETHER: the int8 calibration
+        # switch swaps both under _swap_lock, and a sync caller must not
+        # pair the old program with the new table (or vice versa)
+        with self._swap_lock:
+            fwd, params = self._fwd, self._params
         if states.shape[0] <= cap:
-            return self._run_device(states)
-        params = self._params
+            return self._collect(self._dispatch(params, states, fwd))
         pending = [
-            self._dispatch(params, states[i:i + cap])
+            self._dispatch(params, states[i:i + cap], fwd)
             for i in range(0, states.shape[0], cap)
         ]
         # chunking is worth SEEING on the scrape endpoint: a persistently

@@ -1,0 +1,54 @@
+"""The int8 rung of the quantized rollout/serving ladder.
+
+Per-channel symmetric int8 WEIGHT quantization plus per-tensor activation
+scales for the conv/fc forward (the learner always keeps f32 — this is
+rollout/serving storage + compute only, exactly like the bf16 rung it
+extends, docs/ingest.md). The module split:
+
+- :mod:`spec` — :class:`QuantSpec`, the frozen calibration result: one
+  JSON-round-tripping, unknown-field-rejecting document with a stable
+  sha256 (the provenance hash every bench row stamps).
+- :mod:`qforward` — :func:`quantize_params` (publish-time f32 -> int8
+  table) and :func:`make_quant_apply`/:func:`make_quant_fwd_sample`
+  (the quantized forward, dequant-free int8 conv where the backend
+  compiles it, scale-folded bf16 conv + f32 epilogue where it doesn't).
+- :mod:`calibrate` — activation-range capture: :class:`CalibrationTap`
+  (the PR-9 shadow-serving tap as a free calibration feed) and the
+  offline static-range paths for recorded batches / env rollouts.
+
+Every ``astype``/precision cast of the rollout ladder lives HERE, behind
+the audited entries ``predict.server_int8``/``fused.actor_int8`` —
+ba3clint rule A16 (unaudited-dtype-cast) holds the rest of the
+publish/actor path to that.
+"""
+
+from distributed_ba3c_tpu.quantize.calibrate import (
+    ActRangeAccumulator,
+    CalibrationTap,
+    calibrate_from_env,
+    calibrate_offline,
+)
+from distributed_ba3c_tpu.quantize.qforward import (
+    QUANT_ARMS,
+    int8_conv_supported,
+    make_quant_apply,
+    make_quant_fwd_sample,
+    quant_layer_names,
+    quantize_params,
+)
+from distributed_ba3c_tpu.quantize.spec import QUANT_METHODS, QuantSpec
+
+__all__ = [
+    "ActRangeAccumulator",
+    "CalibrationTap",
+    "QUANT_ARMS",
+    "QUANT_METHODS",
+    "QuantSpec",
+    "calibrate_from_env",
+    "calibrate_offline",
+    "int8_conv_supported",
+    "make_quant_apply",
+    "make_quant_fwd_sample",
+    "quant_layer_names",
+    "quantize_params",
+]

@@ -1,0 +1,225 @@
+"""Activation-scale calibration for the int8 forward.
+
+One accumulator, three feeds:
+
+- :class:`CalibrationTap` — the live-traffic path: a ``shadow_tap``
+  (predict/server.py, the PR-9 shadow-serving hook) that observes every
+  SERVED batch, accumulates per-layer input absmax/percentile stats, and
+  freezes a :class:`QuantSpec` after N batches. Zero new wire machinery:
+  calibration is a shadow consumer of the traffic the tier already
+  serves.
+- :func:`calibrate_offline` — the static-range path over recorded
+  batches (an iterable of state arrays), for when there is no live tier.
+- :func:`calibrate_from_env` — the no-traffic-at-all fallback the fused
+  trainer uses (``--quant_calibrate N`` with ``--overlap``): f32 rollout
+  windows through the SAME scan body the actor program runs, feeding the
+  visited frame stacks to the accumulator.
+
+Determinism contract (tested): the running statistics are maxima —
+permutation-invariant over batches — so the same traffic (same batch
+partition) freezes a bit-identical QuantSpec regardless of interleaving.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax import lax
+
+from distributed_ba3c_tpu.models.a3c import conv_layout
+from distributed_ba3c_tpu.quantize.qforward import quant_layer_names
+from distributed_ba3c_tpu.quantize.spec import QUANT_METHODS, QuantSpec
+
+_DIMENSION_NUMBERS = ("NHWC", "HWIO", "NHWC")
+
+
+def _make_stats_fn(model, method: str, percentile: float) -> Callable:
+    """Build the jitted per-batch statistics forward: an f32 replication
+    of the conv stack (the quantized program's own numeric reference —
+    deliberately NOT the bf16 training forward) that returns each
+    quantized layer's INPUT statistic as one fused device pass."""
+    layout = conv_layout(model)
+
+    def stat(x):
+        a = jnp.abs(x)
+        if method == "absmax":
+            return jnp.max(a)
+        return jnp.percentile(a, percentile)
+
+    def stats_fn(params, states):
+        x = states.astype(jnp.float32)
+        if states.dtype == jnp.uint8:
+            x = x / 255.0
+        out = {}
+        for i, (_feats, _k, pooled) in enumerate(layout):
+            name = f"Conv_{i}"
+            out[name] = stat(x)
+            p = params[name]
+            x = lax.conv_general_dilated(
+                x, jnp.asarray(p["kernel"], jnp.float32), (1, 1), "SAME",
+                dimension_numbers=_DIMENSION_NUMBERS,
+            ) + jnp.asarray(p["bias"], jnp.float32)
+            x = nn.relu(x)
+            if pooled:
+                x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        out["Dense_0"] = stat(x)
+        return out
+
+    return jax.jit(stats_fn)
+
+
+class ActRangeAccumulator:
+    """Running per-layer activation-range statistics -> a QuantSpec.
+
+    ``observe(states)`` folds one batch in (running max of the per-batch
+    statistic — for absmax that IS the global absmax; for percentile it
+    is the conservative max-of-batch-percentiles, deterministic for a
+    given batch partition). ``freeze()`` maps each range to the
+    symmetric scale ``range / 127`` with the zero-range -> 1.0 guard.
+    """
+
+    def __init__(self, model, params, method: str = "absmax",
+                 percentile: float = 99.9):
+        if method not in QUANT_METHODS:
+            raise ValueError(
+                f"method must be one of {QUANT_METHODS}, got {method!r}"
+            )
+        self._params = params
+        self._stats_fn = _make_stats_fn(model, method, percentile)
+        self.method = method
+        self.percentile = float(percentile)
+        self._ranges = {name: 0.0 for name in quant_layer_names(model)}
+        self.batches = 0
+        self.rows = 0
+
+    def observe(self, states) -> None:
+        states = jnp.asarray(states)
+        stats = jax.device_get(self._stats_fn(self._params, states))
+        for name, v in stats.items():
+            v = float(v)
+            if np.isfinite(v):  # a NaN frame must not poison the spec
+                self._ranges[name] = max(self._ranges[name], v)
+        self.batches += 1
+        self.rows += int(states.shape[0])
+
+    def freeze(self) -> QuantSpec:
+        scales = {
+            name: (r / 127.0 if r > 0 else 1.0)
+            for name, r in self._ranges.items()
+        }
+        return QuantSpec(
+            act_scales=scales,
+            method=self.method,
+            percentile=self.percentile,
+            calibration_batches=self.batches,
+            calibration_rows=self.rows,
+        )
+
+
+class CalibrationTap:
+    """A ``shadow_tap`` that calibrates: install on a BatchedPredictor
+    (which also mirrors traffic via ``set_shadow``) and every served
+    batch feeds the accumulator until ``batches`` are seen; then the
+    spec freezes EXACTLY ONCE and ``on_freeze(spec)`` fires — the
+    predictor's hook to switch its serving table to int8 in place.
+
+    The tap runs on the scheduler thread (the shadow-fetch path), so
+    ``on_freeze`` may safely swap the predictor's compiled program and
+    policy table — no dispatch is concurrent with it. Per-batch cost is
+    one small jitted stats forward; the overhead test holds it to the
+    alternating-reps budget (tests/test_quantize.py).
+    """
+
+    def __init__(self, model, params, batches: int,
+                 method: str = "absmax", percentile: float = 99.9,
+                 on_freeze: Optional[Callable[[QuantSpec], None]] = None,
+                 tele_role: Optional[str] = None):
+        if batches < 1:
+            raise ValueError(f"calibration needs >= 1 batch, got {batches}")
+        self._acc = ActRangeAccumulator(
+            model, params, method=method, percentile=percentile
+        )
+        self.batches_target = int(batches)
+        self._on_freeze = on_freeze
+        self.spec: Optional[QuantSpec] = None
+        self._c_batches = self._c_rows = None
+        if tele_role is not None:
+            from distributed_ba3c_tpu import telemetry
+
+            tele = telemetry.registry(tele_role)
+            self._c_batches = tele.counter("quant_calib_batches_total")
+            self._c_rows = tele.counter("quant_calib_rows_total")
+            tele.gauge(
+                "quant_spec_frozen",
+                fn=lambda: 1.0 if self.spec is not None else 0.0,
+            )
+
+    def __call__(self, states, actions, policy) -> None:
+        if self.spec is not None:
+            return  # frozen: the tap is inert until uninstalled
+        self._acc.observe(states)
+        if self._c_batches is not None:
+            self._c_batches.inc()
+            self._c_rows.inc(int(np.shape(states)[0]))
+        if self._acc.batches >= self.batches_target:
+            self.spec = self._acc.freeze()
+            if self._on_freeze is not None:
+                self._on_freeze(self.spec)
+
+
+def calibrate_offline(model, params, batches: Iterable,
+                      method: str = "absmax",
+                      percentile: float = 99.9) -> QuantSpec:
+    """Static-range calibration over recorded state batches (each item
+    one ``[B, H, W, hist]`` array) — the no-live-traffic path."""
+    acc = ActRangeAccumulator(
+        model, params, method=method, percentile=percentile
+    )
+    for states in batches:
+        acc.observe(states)
+    if acc.batches == 0:
+        raise ValueError("offline calibration saw zero batches")
+    return acc.freeze()
+
+
+def calibrate_from_env(model, cfg, env, params, key, n_envs: int,
+                       batches: int, rollout_len: int = 20,
+                       method: str = "absmax",
+                       percentile: float = 99.9) -> QuantSpec:
+    """Pre-training calibration for the fused/overlap trainer: run
+    ``batches`` f32 rollout windows through the SAME scan body the actor
+    program executes (fused/loop.py ``make_rollout_body``) from the same
+    reset distribution, and feed every visited frame stack in. The spec
+    this freezes is what ``--rollout_dtype int8 --quant_calibrate N``
+    builds the int8 actor program from."""
+    from distributed_ba3c_tpu.fused.loop import make_rollout_body
+
+    if batches < 1:
+        raise ValueError(f"calibration needs >= 1 window, got {batches}")
+    acc = ActRangeAccumulator(
+        model, params, method=method, percentile=percentile
+    )
+    keys = jax.random.split(key, n_envs)
+    env_state = jax.vmap(env.reset)(keys)
+    obs = jax.vmap(env.render)(env_state)
+    stack = jnp.zeros(
+        (n_envs, *obs.shape[1:], cfg.frame_history), jnp.uint8
+    ).at[..., -1].set(obs)
+    body = make_rollout_body(model, cfg, env, params)
+    run = jax.jit(lambda c: lax.scan(body, c, None, length=rollout_len))
+    carry = (
+        env_state, stack, jax.random.fold_in(key, 1),
+        jnp.zeros(n_envs, jnp.float32),
+        jnp.zeros(n_envs, jnp.int32),
+        jnp.zeros(n_envs, jnp.float32),
+    )
+    for _ in range(batches):
+        carry, traj = run(carry)
+        stacks = np.asarray(traj[0])  # [T, B, H, W, hist] uint8
+        acc.observe(stacks.reshape(-1, *stacks.shape[2:]))
+    return acc.freeze()

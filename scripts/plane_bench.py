@@ -775,6 +775,10 @@ def main() -> int:
         "envs_per_proc": args.envs_per_proc,
         "seconds": args.seconds,
         "telemetry": args.telemetry,
+        # the plane instrument drives f32 masters end to end — stamped so
+        # every bench row names its rung of the rollout-precision ladder
+        # (serving_bench --dtype covers the quantized rungs)
+        "rollout_dtype": "float32",
         "runs": runs,
     }
     if overhead:

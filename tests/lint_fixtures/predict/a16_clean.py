@@ -1,0 +1,26 @@
+"""A16 clean fixture: the casts the publish/actor-forward path IS allowed.
+
+f32 is the ladder's base rung (not a quantization), integer index/obs
+dtypes are not serving numerics, and the quantizing cast itself is
+delegated to the sanctioned hook a ``rollout_dtype`` switch selects.
+"""
+import jax.numpy as jnp
+from jax import lax
+
+
+def to_full_precision(params):
+    # widening back to the base rung is not a quantization
+    return jnp.asarray(params).astype(jnp.float32)
+
+
+def pack_actions(actions):
+    return jnp.asarray(actions).astype(jnp.int32)
+
+
+def frame_bytes(obs):
+    return lax.convert_element_type(obs, jnp.uint8)
+
+
+def select_cast(rollout_dtype, cast_hooks):
+    # dtype selection delegated to the sanctioned (audited) hook table
+    return cast_hooks[rollout_dtype]

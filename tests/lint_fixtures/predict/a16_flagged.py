@@ -1,0 +1,25 @@
+"""A16 fixture: ad-hoc bf16/int8 casts on the publish/actor-forward path.
+
+Lives under a ``predict/`` directory on purpose — the rule only applies
+to the params-publish/actor-forward path (predict/, fused/, pod/); the
+sanctioned homes are ``quantize/`` and THE suppressed audited cast site.
+"""
+import jax.numpy as jnp
+from jax import lax
+
+
+def publish_cast(params):
+    # ad-hoc quantizing publish cast: no audit entry pins this program
+    return jnp.asarray(params).astype(jnp.bfloat16)
+
+
+def publish_cast_stringly(params):
+    return jnp.asarray(params).astype("int8")
+
+
+def forward_cast(x):
+    return lax.convert_element_type(x, jnp.int8)
+
+
+def forward_cast_kw(x):
+    return lax.convert_element_type(x, new_dtype=jnp.bfloat16)

@@ -265,6 +265,7 @@ def test_registry_names():
     assert audit_mod.entry_names() == [
         "fused.actor",
         "fused.actor_bf16",
+        "fused.actor_int8",
         "fused.greedy_eval",
         "fused.learner",
         "fused.macro_learner",
@@ -277,6 +278,7 @@ def test_registry_names():
         "predict.server",
         "predict.server_bf16",
         "predict.server_greedy",
+        "predict.server_int8",
     ]
 
 

@@ -19,7 +19,7 @@ from tools.ba3clint.engine import suppressions
 FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-RULE_IDS = ["J1", "J2", "J3", "J4", "J5", "J6", "A1", "A2", "A3", "A4", "A5", "A6", "A7", "A8", "A9", "A10", "A11", "A12", "A13", "A14", "A15"]
+RULE_IDS = ["J1", "J2", "J3", "J4", "J5", "J6", "A1", "A2", "A3", "A4", "A5", "A6", "A7", "A8", "A9", "A10", "A11", "A12", "A13", "A14", "A15", "A16"]
 
 
 def _fixture(name):
@@ -77,6 +77,7 @@ def test_expected_flag_counts():
     assert len(_findings("a9_flagged.py", "A9")) == 5
     assert len(_findings("a11_flagged.py", "A11")) == 4
     assert len(_findings("a12_flagged.py", "A12")) == 2
+    assert len(_findings("a16_flagged.py", "A16")) == 4
 
 
 def test_a12_file_level_sockopt_timeout_sanctions(tmp_path):

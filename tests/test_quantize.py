@@ -1,0 +1,613 @@
+"""The int8 rung (distributed_ba3c_tpu/quantize, docs/ingest.md).
+
+Five contracts:
+
+- **QuantSpec**: lossless JSON round-trip with unknown-field rejection,
+  content-addressed hash, and validation that can never emit a spec the
+  forward would divide by zero (or NaN) on — degenerate zero-range
+  channels freeze to a VALID scale.
+- **Calibration determinism**: the same traffic (same batch partition)
+  freezes a bit-identical spec regardless of batch order — running maxima
+  are permutation-invariant, so a re-run reproduces the committed hash.
+- **Parity bands on real frames**: the int8 forward (both the int8-conv
+  arm and the scale-folded fallback) stays inside the bf16 rung's own
+  bands vs f32 on real jax-Pong AND jax-Seaquest observations — int8
+  must not be a worse serving-numerics rung than the one below it.
+- **End-to-end**: the overlap trainer's int8 actor learns in parity with
+  f32 at lag 0, and the BatchedPredictor both serves a frozen spec
+  immediately and calibrates one live (shadow tap → freeze → in-place
+  switch) — with the usage errors exit-2-clean at every entry point.
+- **Tap overhead**: calibration rides the serving plane inside a loose
+  alternating-reps budget (the plane_bench --trace methodology — off/on
+  interleaved so host drift cancels, medians compared).
+"""
+
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_ba3c_tpu.quantize import (
+    ActRangeAccumulator,
+    CalibrationTap,
+    QuantSpec,
+    calibrate_from_env,
+    calibrate_offline,
+    make_quant_apply,
+    quant_layer_names,
+    quantize_params,
+)
+from distributed_ba3c_tpu.quantize.spec import QuantSpecError
+
+#: the bf16 rung's own acceptance bands (test_staging.py) — the int8 rung
+#: must sit inside them
+BAND_LOG_MU = 0.1
+BAND_VALUE = 0.05
+
+
+@pytest.fixture(scope="module")
+def pong_parts():
+    from distributed_ba3c_tpu.config import BA3CConfig
+    from distributed_ba3c_tpu.envs.jaxenv import pong
+    from distributed_ba3c_tpu.models.a3c import BA3CNet
+    from distributed_ba3c_tpu.ops.gradproc import make_optimizer
+    from distributed_ba3c_tpu.parallel.mesh import make_mesh
+
+    cfg = BA3CConfig(num_actions=pong.num_actions, fc_units=16)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    opt = make_optimizer(
+        cfg.learning_rate, cfg.adam_epsilon, cfg.grad_clip_norm
+    )
+    return cfg, model, opt, make_mesh(), pong
+
+
+def _init_params(model, cfg, seed=0):
+    return model.init(
+        jax.random.PRNGKey(seed),
+        np.zeros((1, *cfg.state_shape), np.uint8),
+    )["params"]
+
+
+def _real_frames(cfg, model, opt, env, n_envs=4, rollout_len=8, seed=0):
+    """Real game frame stacks via the actor's own scan body — parity and
+    calibration must be measured on the pixel distribution the rollout
+    forward actually sees, not on white noise."""
+    from jax import lax
+
+    from distributed_ba3c_tpu.fused.loop import make_rollout_body
+
+    key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, n_envs)
+    env_state = jax.vmap(env.reset)(keys)
+    obs = jax.vmap(env.render)(env_state)
+    stack = jnp.zeros(
+        (n_envs, *obs.shape[1:], cfg.frame_history), jnp.uint8
+    ).at[..., -1].set(obs)
+    params = _init_params(model, cfg)
+    body = make_rollout_body(model, cfg, env, params)
+    carry = (
+        env_state, stack, jax.random.fold_in(key, 1),
+        jnp.zeros(n_envs, jnp.float32), jnp.zeros(n_envs, jnp.int32),
+        jnp.zeros(n_envs, jnp.float32),
+    )
+    _, traj = jax.jit(
+        lambda c: lax.scan(body, c, None, length=rollout_len)
+    )(carry)
+    return params, np.asarray(traj[0]).reshape(-1, *cfg.state_shape)
+
+
+# -------------------------------------------------------------------------
+# QuantSpec
+# -------------------------------------------------------------------------
+
+
+def _spec(**over):
+    kw = dict(
+        act_scales={"Conv_0": 0.5, "Dense_0": 1.25},
+        method="absmax",
+        calibration_batches=4,
+        calibration_rows=64,
+    )
+    kw.update(over)
+    return QuantSpec(**kw)
+
+
+def test_spec_json_roundtrip_and_hash(tmp_path):
+    spec = _spec()
+    again = QuantSpec.from_json(spec.to_json())
+    assert again == spec
+    assert again.sha256() == spec.sha256()
+    p = tmp_path / "spec.json"
+    spec.save(str(p))
+    assert QuantSpec.load(str(p)) == spec
+    # the hash is content-addressed: a different scale is a different spec
+    assert _spec(act_scales={"Conv_0": 0.5, "Dense_0": 1.5}).sha256() \
+        != spec.sha256()
+
+
+def test_spec_rejects_unknown_fields():
+    doc = _spec().to_doc()
+    doc["mystery_knob"] = 1
+    with pytest.raises(QuantSpecError):
+        QuantSpec.from_doc(doc)
+
+
+@pytest.mark.parametrize("bad", [0.0, -1.0, float("nan"), float("inf")])
+def test_spec_rejects_degenerate_scales(bad):
+    """A spec the forward would divide by zero (or NaN) on can never be
+    constructed, loaded or round-tripped."""
+    with pytest.raises(QuantSpecError):
+        QuantSpec(act_scales={"Conv_0": bad})
+
+
+def test_spec_rejects_bad_method_and_empty():
+    with pytest.raises(QuantSpecError):
+        QuantSpec(act_scales={"Conv_0": 1.0}, method="vibes")
+    with pytest.raises(QuantSpecError):
+        QuantSpec(act_scales={})
+
+
+def test_zero_range_freezes_to_valid_scale(pong_parts):
+    """Degenerate calibration (all-zero traffic) must freeze a spec with
+    finite positive scales — the no-signal fallback is scale 1.0, never
+    a divide-by-zero shipped to the serving plane."""
+    cfg, model, opt, _mesh, _pong = pong_parts
+    params = _init_params(model, cfg)
+    acc = ActRangeAccumulator(model, params)
+    acc.observe(np.zeros((4, *cfg.state_shape), np.uint8))
+    spec = acc.freeze()
+    # Conv_0's input is the all-zero frame: zero range -> scale 1.0
+    assert spec.act_scales["Conv_0"] == 1.0
+    for v in spec.act_scales.values():
+        assert np.isfinite(v) and v > 0
+
+
+def test_zero_range_weight_channel_valid_scale(pong_parts):
+    """An all-zero output channel quantizes with w_scale 1.0 (finite),
+    and the quantized kernel stays all-zero — no NaN/inf in the table."""
+    cfg, model, opt, _mesh, _pong = pong_parts
+    params = jax.tree_util.tree_map(
+        lambda a: np.array(a), jax.device_get(_init_params(model, cfg))
+    )
+    params["Conv_0"]["kernel"][..., 0] = 0.0
+    spec = _full_spec(model)
+    q = quantize_params(params, spec)
+    assert np.all(np.asarray(q["Conv_0"]["kernel_q"][..., 0]) == 0)
+    w_scale = np.asarray(q["Conv_0"]["w_scale"])
+    assert np.isfinite(w_scale).all() and (w_scale > 0).all()
+
+
+def _full_spec(model):
+    return QuantSpec(act_scales={n: 1.0 for n in quant_layer_names(model)})
+
+
+def test_quantize_params_table_shape(pong_parts):
+    cfg, model, opt, _mesh, _pong = pong_parts
+    params = _init_params(model, cfg)
+    q = jax.device_get(quantize_params(params, _full_spec(model)))
+    for name in quant_layer_names(model):
+        assert q[name]["kernel_q"].dtype == np.int8
+        assert q[name]["w_scale"].dtype == np.float32
+        assert q[name]["w_scale"].shape == (params[name]["kernel"].shape[-1],)
+        assert q[name]["act_scale"].shape == ()
+    # the heads stay f32 and untouched
+    np.testing.assert_array_equal(
+        q["Dense_1"]["kernel"], jax.device_get(params["Dense_1"]["kernel"])
+    )
+
+
+def test_quantize_params_missing_layer_raises(pong_parts):
+    cfg, model, opt, _mesh, _pong = pong_parts
+    params = _init_params(model, cfg)
+    with pytest.raises(ValueError):
+        quantize_params(
+            params, QuantSpec(act_scales={"Conv_99": 1.0})
+        )
+
+
+# -------------------------------------------------------------------------
+# calibration determinism
+# -------------------------------------------------------------------------
+
+
+def test_calibration_deterministic_and_order_invariant(pong_parts):
+    """Same traffic partition -> bit-identical spec (same JSON, same
+    hash), in ANY batch order — the committed hash is reproducible."""
+    cfg, model, opt, _mesh, pong = pong_parts
+    params, frames = _real_frames(cfg, model, opt, pong)
+    batches = [frames[i::3] for i in range(3)]
+    a = calibrate_offline(model, params, batches)
+    b = calibrate_offline(model, params, batches)
+    c = calibrate_offline(model, params, list(reversed(batches)))
+    assert a.to_json() == b.to_json() == c.to_json()
+    assert a.sha256() == c.sha256()
+    assert a.calibration_batches == 3
+    assert a.calibration_rows == len(frames)
+
+
+def test_offline_calibration_zero_batches_raises(pong_parts):
+    cfg, model, opt, _mesh, _pong = pong_parts
+    with pytest.raises(ValueError):
+        calibrate_offline(model, _init_params(model, cfg), [])
+
+
+def test_calibrate_from_env_produces_loadable_spec(pong_parts, tmp_path):
+    """The fused trainer's --quant_calibrate path: env-rollout
+    calibration freezes a spec that survives the file round-trip the pod
+    hosts load it through."""
+    cfg, model, opt, _mesh, pong = pong_parts
+    params = _init_params(model, cfg)
+    spec = calibrate_from_env(
+        model, cfg, pong, params, jax.random.PRNGKey(3),
+        n_envs=4, batches=2, rollout_len=4,
+    )
+    assert set(spec.layers) == set(quant_layer_names(model))
+    p = tmp_path / "spec.json"
+    spec.save(str(p))
+    assert QuantSpec.load(str(p)).sha256() == spec.sha256()
+
+
+# -------------------------------------------------------------------------
+# parity bands on real frames
+# -------------------------------------------------------------------------
+
+
+def _parity(cfg, model, opt, env, arm):
+    params, frames = _real_frames(cfg, model, opt, env)
+    spec = calibrate_offline(model, params, [frames])
+    q = quantize_params(params, spec)
+    out32 = model.apply({"params": params}, jnp.asarray(frames))
+    outq = make_quant_apply(model, arm=arm)(q, jnp.asarray(frames))
+    lp32 = jax.nn.log_softmax(out32.logits, axis=-1)
+    lpq = jax.nn.log_softmax(outq.logits, axis=-1)
+    return (
+        float(jnp.max(jnp.abs(lp32 - lpq))),
+        float(jnp.max(jnp.abs(out32.value - outq.value))),
+    )
+
+
+@pytest.mark.parametrize("arm", ["int8", "folded"])
+def test_int8_parity_band_on_pong(pong_parts, arm):
+    """The rung's numeric claim on real Pong pixels: both arms inside
+    the bf16 bands (log mu within 0.1, V within 0.05)."""
+    cfg, model, opt, _mesh, pong = pong_parts
+    d_logmu, d_value = _parity(cfg, model, opt, pong, arm)
+    assert d_logmu < BAND_LOG_MU, d_logmu
+    assert d_value < BAND_VALUE, d_value
+
+
+def test_int8_parity_band_on_seaquest():
+    """Second game, denser pixel statistics than Pong — the calibrated
+    ranges must hold the band there too."""
+    from distributed_ba3c_tpu.config import BA3CConfig
+    from distributed_ba3c_tpu.envs.jaxenv import seaquest
+    from distributed_ba3c_tpu.models.a3c import BA3CNet
+    from distributed_ba3c_tpu.ops.gradproc import make_optimizer
+
+    cfg = BA3CConfig(num_actions=seaquest.num_actions, fc_units=16)
+    model = BA3CNet(num_actions=cfg.num_actions, fc_units=cfg.fc_units)
+    opt = make_optimizer(
+        cfg.learning_rate, cfg.adam_epsilon, cfg.grad_clip_norm
+    )
+    d_logmu, d_value = _parity(cfg, model, opt, seaquest, "int8")
+    assert d_logmu < BAND_LOG_MU, d_logmu
+    assert d_value < BAND_VALUE, d_value
+
+
+# -------------------------------------------------------------------------
+# overlap trainer end-to-end
+# -------------------------------------------------------------------------
+
+
+def test_overlap_int8_requires_spec(pong_parts):
+    from distributed_ba3c_tpu.fused.overlap import make_overlap_step
+
+    cfg, model, opt, mesh, pong = pong_parts
+    with pytest.raises(ValueError, match="quant_spec"):
+        make_overlap_step(
+            model, opt, cfg, mesh, pong, rollout_len=3,
+            rollout_dtype="int8",
+        )
+
+
+def test_int8_lag0_learning_parity_on_pong(pong_parts):
+    """Lag-0 overlap with the int8 actor vs f32: identical initial state
+    and keys, only the rollout forward's precision differs — the first
+    update optimizes the same objective inside the bf16 band, and both
+    keep training finitely."""
+    from distributed_ba3c_tpu.fused.loop import create_fused_state
+    from distributed_ba3c_tpu.fused.overlap import make_overlap_step
+
+    cfg, model, opt, mesh, pong = pong_parts
+    n_data = mesh.shape["data"]
+    n_envs = 2 * n_data
+    params = _init_params(model, cfg)
+    spec = calibrate_from_env(
+        model, cfg, pong, params, jax.random.PRNGKey(5),
+        n_envs=n_envs, batches=2, rollout_len=4,
+    )
+
+    def run(dtype, quant_spec=None):
+        step = make_overlap_step(
+            model, opt, cfg, mesh, pong, rollout_len=3, lag=0,
+            rollout_dtype=dtype, quant_spec=quant_spec,
+        )
+        state = step.put(
+            create_fused_state(
+                jax.random.PRNGKey(0), model, cfg, opt, pong, n_envs,
+                n_shards=n_data,
+            )
+        )
+        ms = []
+        for _ in range(2):
+            state, m = step(state, cfg.entropy_beta)
+            ms.append({k: float(v) for k, v in m.items()})
+        return ms
+
+    f32 = run("float32")
+    i8 = run("int8", quant_spec=spec)
+    for ms in (f32, i8):
+        for m in ms:
+            for k, v in m.items():
+                assert np.isfinite(v), k
+    assert abs(f32[0]["loss"] - i8[0]["loss"]) < 0.05
+    assert abs(f32[0]["pred_value"] - i8[0]["pred_value"]) < 0.05
+    assert abs(f32[0]["entropy"] - i8[0]["entropy"]) < 0.05
+
+
+# -------------------------------------------------------------------------
+# BatchedPredictor end-to-end
+# -------------------------------------------------------------------------
+
+
+def test_predictor_int8_immediate_table_and_band(pong_parts):
+    """rollout_dtype=int8 with a frozen spec: the table is quantized at
+    construction, serving works, values inside the band of the f32
+    server, and a fresh f32 publish lands re-quantized."""
+    from distributed_ba3c_tpu.predict.server import BatchedPredictor
+
+    cfg, model, opt, _mesh, pong = pong_parts
+    params, frames = _real_frames(cfg, model, opt, pong)
+    spec = calibrate_offline(model, params, [frames])
+    states = frames[:4]
+    p32 = BatchedPredictor(model, params, batch_size=4, greedy=True)
+    p8 = BatchedPredictor(
+        model, params, batch_size=4, greedy=True,
+        rollout_dtype="int8", quant_spec=spec,
+        tele_role="predictor.int8",
+    )
+    assert p8.serving_dtype == "int8"
+    table = p8._policies["default"]
+    assert np.asarray(table["Conv_0"]["kernel_q"]).dtype == np.int8
+    _, v32, _ = p32.predict_batch(states)
+    _, v8, _ = p8.predict_batch(states)
+    assert np.max(np.abs(v32 - v8)) < BAND_VALUE
+    p8.update_params(jax.device_put(params))
+    table = p8._policies["default"]
+    assert np.asarray(table["Conv_0"]["kernel_q"]).dtype == np.int8
+    a8, _, _ = p8.predict_batch(states)
+    assert a8.shape == (4,)
+
+
+def test_predictor_quant_ctor_validation(pong_parts):
+    from distributed_ba3c_tpu.predict.server import BatchedPredictor
+
+    cfg, model, opt, _mesh, _pong = pong_parts
+    params = _init_params(model, cfg)
+    spec = _full_spec(model)
+    # int8 with no source, int8 with both sources, quant args off-int8
+    with pytest.raises(ValueError):
+        BatchedPredictor(model, params, rollout_dtype="int8")
+    with pytest.raises(ValueError):
+        BatchedPredictor(
+            model, params, rollout_dtype="int8",
+            quant_spec=spec, quant_calibrate=4,
+        )
+    with pytest.raises(ValueError):
+        BatchedPredictor(
+            model, params, rollout_dtype="bfloat16", quant_spec=spec
+        )
+
+
+def _drain(pred, states, n):
+    done = threading.Event()
+    left = [n]
+    for _ in range(n):
+        def cb(a, v, lp):
+            left[0] -= 1
+            if left[0] == 0:
+                done.set()
+
+        pred.put_block_task(states, cb)
+    assert done.wait(120)
+
+
+def test_predictor_calibrate_then_switch(pong_parts):
+    """The live-calibration path end to end: serve f32 while the shadow
+    tap accumulates, freeze after N batches, switch the plane to int8 in
+    place — table quantized, tap uninstalled, async AND sync serving
+    keep working on the SAME predictor."""
+    from distributed_ba3c_tpu.predict.server import BatchedPredictor
+
+    cfg, model, opt, _mesh, pong = pong_parts
+    params, frames = _real_frames(cfg, model, opt, pong)
+    states = frames[:4]
+    pred = BatchedPredictor(
+        model, params, batch_size=4, greedy=True, coalesce_ms=0.0,
+        rollout_dtype="int8", quant_calibrate=3,
+        tele_role="predictor.calib",
+    )
+    assert pred.serving_dtype == "float32"  # not calibrated yet
+    assert pred.shadow_tap is not None
+    pred.warmup(cfg.state_shape)
+    pred.start()
+    try:
+        _drain(pred, states, 4)
+        deadline = time.monotonic() + 60
+        while pred.quant_spec is None and time.monotonic() < deadline:
+            _drain(pred, states, 1)
+        assert pred.quant_spec is not None, "spec never froze"
+        assert pred.serving_dtype == "int8"
+        assert pred.shadow_tap is None and pred._shadow is None
+        table = pred._policies["default"]
+        assert np.asarray(table["Conv_0"]["kernel_q"]).dtype == np.int8
+        assert pred.quant_spec.calibration_batches == 3
+        # async serving continues on the switched program
+        _drain(pred, states, 2)
+        # and the sync path sees program+table consistently
+        a, v, _ = pred.predict_batch(states)
+        assert a.shape == (4,) and np.isfinite(v).all()
+    finally:
+        pred.stop()
+
+
+def test_calibration_tap_overhead_alternating_reps(pong_parts):
+    """The tap's cost rides inside a loose budget, measured the
+    plane_bench --trace way: off/on reps ALTERNATE so host drift hits
+    both sides equally, medians compared. The bound is deliberately slack
+    (the calibrating plane mirrors every batch by design — the PR-9
+    shadow cost — and CI hosts are 1-core): the gate catches the tap
+    going accidentally synchronous-per-row, not percent-level noise."""
+    from distributed_ba3c_tpu.predict.server import BatchedPredictor
+
+    cfg, model, opt, _mesh, pong = pong_parts
+    params, frames = _real_frames(cfg, model, opt, pong)
+    states = frames[:4]
+
+    off = BatchedPredictor(
+        model, params, batch_size=4, greedy=True, coalesce_ms=0.0,
+        tele_role="predictor.tap_off",
+    )
+    on = BatchedPredictor(
+        model, params, batch_size=4, greedy=True, coalesce_ms=0.0,
+        rollout_dtype="int8", quant_calibrate=10_000,  # never freezes here
+        tele_role="predictor.tap_on",
+    )
+    for p in (off, on):
+        p.warmup(cfg.state_shape)
+        p.start()
+    try:
+        _drain(off, states, 3)  # warm both paths (incl. the tap's
+        _drain(on, states, 3)   # stats-forward compile) before timing
+        t_off, t_on = [], []
+        for _ in range(4):
+            t0 = time.monotonic()
+            _drain(off, states, 6)
+            t_off.append(time.monotonic() - t0)
+            t0 = time.monotonic()
+            _drain(on, states, 6)
+            t_on.append(time.monotonic() - t0)
+        ratio = float(np.median(t_on) / max(np.median(t_off), 1e-9))
+        assert ratio < 6.0, (ratio, t_off, t_on)
+        assert on.quant_spec is None  # still calibrating, never froze
+    finally:
+        off.stop()
+        on.stop()
+
+
+# -------------------------------------------------------------------------
+# topology / flag surface
+# -------------------------------------------------------------------------
+
+
+def test_mode_topology_quant_validation():
+    from distributed_ba3c_tpu.orchestrate.topology import (
+        ModeTopology,
+        TopologyError,
+    )
+
+    # exactly-one-source, both ways
+    with pytest.raises(TopologyError):
+        ModeTopology(rollout_dtype="int8")
+    with pytest.raises(TopologyError):
+        ModeTopology(
+            rollout_dtype="int8", quant_spec="s.json", quant_calibrate=4
+        )
+    # quant knobs are int8-only
+    with pytest.raises(TopologyError):
+        ModeTopology(rollout_dtype="bfloat16", quant_calibrate=4)
+    with pytest.raises(TopologyError):
+        ModeTopology(rollout_dtype="float32", quant_spec="s.json")
+    with pytest.raises(TopologyError):
+        ModeTopology(rollout_dtype="float8")
+    ModeTopology(rollout_dtype="int8", quant_spec="s.json")
+    ModeTopology(
+        trainer="tpu_fused_ba3c", overlap=True, rollout_dtype="int8",
+        quant_calibrate=8,
+    )
+
+
+def test_topology_int8_fused_requires_overlap():
+    """Cross-section rule: int8 quantizes the ACTOR program's snapshot,
+    so the fused trainer must run the overlap split."""
+    from distributed_ba3c_tpu.orchestrate.topology import (
+        ModeTopology,
+        TopologyError,
+        TopologySpec,
+    )
+
+    def spec(**over):
+        return TopologySpec(
+            mode=ModeTopology(
+                task="train", trainer="tpu_fused_ba3c", env="jax:pong",
+                rollout_dtype="int8", quant_calibrate=4, **over,
+            ),
+        )
+
+    with pytest.raises(TopologyError, match="overlap"):
+        spec()
+    spec(overlap=True)
+
+
+def test_topology_roundtrip_carries_quant_fields():
+    from distributed_ba3c_tpu.orchestrate.topology import (
+        ModeTopology,
+        TopologySpec,
+    )
+
+    spec = TopologySpec(
+        mode=ModeTopology(
+            task="train", trainer="tpu_fused_ba3c", env="jax:pong",
+            overlap=True, rollout_dtype="int8", quant_calibrate=16,
+        ),
+    )
+    doc = json.loads(spec.to_json())
+    assert doc["mode"]["rollout_dtype"] == "int8"
+    assert doc["mode"]["quant_calibrate"] == 16
+    again = TopologySpec.from_json(spec.to_json())
+    assert again == spec
+
+
+def test_cli_int8_usage_errors_exit_2():
+    """Both flag surfaces reject a sourceless int8 (and quant knobs
+    off-int8) as clean exit-2 usage errors — no tracebacks."""
+    import subprocess
+    import sys
+
+    cases = [
+        ("distributed_ba3c_tpu.cli", [
+            "--task", "train", "--trainer", "tpu_fused_ba3c", "--overlap",
+            "--env", "jax:pong", "--rollout_dtype", "int8",
+            "--dump_topology",
+        ]),
+        ("distributed_ba3c_tpu.cli", [
+            "--task", "train", "--env", "cpp:pong",
+            "--quant_calibrate", "4", "--dump_topology",
+        ]),
+        ("distributed_ba3c_tpu.pod.host", [
+            "--host_id", "0", "--learner_c2s", "tcp://x:1",
+            "--learner_s2c", "tcp://x:2", "--rollout_dtype", "int8",
+        ]),
+    ]
+    for mod, argv in cases:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             f"from {mod} import main; import sys; sys.exit(main({argv!r}))"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 2, (mod, argv, r.returncode, r.stderr)
+        assert "Traceback" not in r.stderr, r.stderr

@@ -1308,6 +1308,93 @@ class AdhocLifecycleLoopRule(Rule):
                 )
 
 
+#: the two dtypes the quantized-rollout ladder owns end to end — a cast to
+#: either outside the sanctioned sites IS a new serving numerics rung
+_QUANT_CAST_DTYPES = {"bfloat16", "int8"}
+#: path segments that carry the params-publish/actor-forward path
+_QUANT_CAST_SEGMENTS = {"predict", "fused", "pod"}
+
+
+def _quant_cast_dtype(node: Optional[ast.AST]) -> Optional[str]:
+    """The bf16/int8 dtype a cast target names, else None — matches both
+    the ``jnp.bfloat16`` attribute form and the ``"int8"`` string form."""
+    if isinstance(node, ast.Attribute) and node.attr in _QUANT_CAST_DTYPES:
+        return node.attr
+    if (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and node.value in _QUANT_CAST_DTYPES
+    ):
+        return node.value
+    return None
+
+
+class UnauditedDtypeCastRule(Rule):
+    """A16: ad-hoc bf16/int8 cast on the params-publish/actor-forward
+    path outside ``quantize/``.
+
+    The rollout-precision ladder is AUDIT-PINNED: every dtype the serving
+    and actor forwards run at has a registered entry point
+    (predict.server_bf16 / fused.actor_bf16 / predict.server_int8 /
+    fused.actor_int8) whose compiled program the manifest's T1/T5 rows
+    structurally pin. An ``astype(jnp.bfloat16)`` /
+    ``lax.convert_element_type(..., jnp.int8)`` added ad hoc in
+    ``predict/``, ``fused/`` or ``pod/`` is a serving-numerics change no
+    audit sees: the program it produces has no entry, no byte census, no
+    parity band — a precision regression (or an accidental double-cast)
+    ships silently. Quantizing casts belong to ``quantize/`` (the int8
+    rung's one home: per-channel scales, calibrated activation ranges,
+    the audited epilogue) or to THE audited publish-cast site, which
+    carries the suppression naming its entry. Everything else on this
+    path hands dtype selection to ``rollout_dtype`` and the sanctioned
+    cast hooks. f32 casts stay clean — the ladder's base rung is not a
+    quantization.
+    """
+
+    id = "A16"
+    name = "unaudited-dtype-cast"
+    summary = "ad-hoc bf16/int8 cast on the publish/actor-forward path outside quantize/ dodges the audit"
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        segs = set(ctx.path.replace(os.sep, "/").split("/"))
+        if not segs & _QUANT_CAST_SEGMENTS or "quantize" in segs:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            dt = None
+            via = None
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "astype"
+                and node.args
+            ):
+                dt = _quant_cast_dtype(node.args[0])
+                via = "astype"
+            else:
+                resolved = ctx.info.resolve(fn)
+                if resolved and resolved.endswith("convert_element_type"):
+                    target = node.args[1] if len(node.args) > 1 else next(
+                        (
+                            kw.value for kw in node.keywords
+                            if kw.arg == "new_dtype"
+                        ),
+                        None,
+                    )
+                    dt = _quant_cast_dtype(target)
+                    via = "convert_element_type"
+            if dt:
+                yield ctx.finding(
+                    self, node,
+                    f"ad-hoc {via} to {dt} on the publish/actor-forward "
+                    "path — a serving-numerics change no audit entry "
+                    "pins; route it through rollout_dtype + quantize/ "
+                    "(or suppress naming the audited entry this cast "
+                    "feeds — docs/static_analysis.md)",
+                )
+
+
 ACTOR_RULES = [
     BareThreadRule(),
     BlockingQueueOpRule(),
@@ -1324,4 +1411,5 @@ ACTOR_RULES = [
     IngestExtraCopyRule(),
     UnroutedPredictorDispatchRule(),
     AdhocLifecycleLoopRule(),
+    UnauditedDtypeCastRule(),
 ]
